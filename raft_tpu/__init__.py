@@ -1,0 +1,6 @@
+"""raft-tpu: a TPU-native (JAX/XLA/Pallas) optical-flow framework with the
+capabilities of gonglixue/RAFT-tf, built from scratch.  See SURVEY.md."""
+
+from .config import RAFTConfig, TrainConfig
+
+__version__ = "0.1.0"

@@ -1,0 +1,89 @@
+"""Model / runtime configuration for raft-tpu.
+
+The reference hardcodes its hyperparameters as constructor attributes on the
+model class (reference networks/RAFT.py:26-43) and freezes the iteration count
+at 20 for both variants (RAFT.py:33) even though the paper's eval protocol uses
+12 (small) / 32 (full).  Here every knob is a real config field.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class RAFTConfig:
+    """Static hyperparameters of the RAFT model.
+
+    Mirrors the capability surface of reference networks/RAFT.py:26-43 (full
+    vs --small variants) with the hardcoded values promoted to fields.
+    """
+
+    small: bool = False
+    hidden_dim: int = 128
+    context_dim: int = 128
+    corr_levels: int = 4
+    corr_radius: int = 4
+    iters: int = 32
+    dropout: float = 0.0
+    # 'bgr' matches the reference's cv2 input path (reference RAFT.py:13,
+    # dataflow/test_dataflow.py:56); 'rgb' matches the official weights.
+    channel_order: str = "bgr"
+    # Correlation implementation: 'dense' materializes per-level volumes
+    # (reference model_utils.py:199-221 semantics), 'blockwise' chunks over
+    # query pixels and never materializes the full (HW)^2 volume, 'pallas'
+    # uses the fused TPU kernel (the CUDA-extension equivalent the reference
+    # never wrote, reference readme.md:12).
+    corr_impl: str = "dense"
+    # Compute dtype for conv/matmul-heavy paths ('float32' or 'bfloat16');
+    # the correlation itself always accumulates in float32.
+    compute_dtype: str = "float32"
+    # Rematerialize each GRU iteration during backprop (memory/FLOPs trade).
+    remat_iters: bool = True
+
+    @property
+    def fnet_dim(self) -> int:
+        return 128 if self.small else 256
+
+    @property
+    def cnet_dim(self) -> int:
+        return self.hidden_dim + self.context_dim
+
+    @property
+    def corr_feature_dim(self) -> int:
+        return self.corr_levels * (2 * self.corr_radius + 1) ** 2
+
+    @staticmethod
+    def full(**overrides) -> "RAFTConfig":
+        """raft-things variant (reference RAFT.py:28-35)."""
+        return RAFTConfig(**{**dict(small=False), **overrides})
+
+    @staticmethod
+    def small_model(**overrides) -> "RAFTConfig":
+        """raft-small variant (reference RAFT.py:37-41)."""
+        defaults = dict(small=True, hidden_dim=96, context_dim=64, corr_radius=3, iters=12)
+        return RAFTConfig(**{**defaults, **overrides})
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Training recipe (absent from the reference — SURVEY.md §3.6; realizes
+    the stubbed --optimizer choices at reference infer_raft.py:62-63)."""
+
+    num_steps: int = 100_000
+    batch_size: int = 6
+    image_size: Tuple[int, int] = (368, 496)
+    lr: float = 4e-4
+    weight_decay: float = 1e-5   # reference RAFT.py:14 (declared, unused there)
+    adamw_eps: float = 1e-8
+    clip_norm: float = 1.0
+    gamma: float = 0.8           # sequence-loss decay (RAFT paper eq. 7)
+    optimizer: str = "adamw"     # adam | adamw | sgd | sgd_cyclic | sgd_1cycle
+    schedule: str = "one_cycle"  # one_cycle | constant | cyclic
+    pct_start: float = 0.05
+    max_flow: float = 400.0      # exclude ground-truth flows beyond this
+    seed: int = 0
+    log_every: int = 100
+    ckpt_every: int = 5000
+    ckpt_dir: str = "checkpoints"

@@ -1,0 +1,191 @@
+"""RAFT: the full model, TPU-first.
+
+Re-design of reference networks/RAFT.py:78-134 (``network_graph``):
+
+* the 20x statically-unrolled update loop (reference RAFT.py:91, which copies
+  the graph 20 times) becomes a single ``jax.lax.scan`` over iterations, with
+  optional per-iteration rematerialization for training memory;
+* every iteration's *upsampled* flow is emitted for the sequence loss — the
+  reference discarded intermediates (RAFT.py:109, SURVEY.md §3.6 capability
+  gap);
+* iteration count, batch and resolution are free (fixing reference
+  readme.md:13 and the frozen placeholder shapes at RAFT.py:45-51);
+* correlation can run dense, blockwise (on-demand), or via the fused Pallas
+  kernel (config.corr_impl).
+
+Inputs are float images in [0, 1], NHWC, channel order per config
+(reference preprocessing: RAFT.py:53-59, BGR note at RAFT.py:13).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import RAFTConfig
+from ..ops.coords import coords_grid, upflow8
+from ..ops.corr import build_pyramid, fmap2_pyramid, lookup_dense, lookup_ondemand
+from ..ops.upsample import convex_upsample_flow
+from .encoders import apply_encoder, init_encoder
+from .update import (apply_basic_update_block, apply_small_update_block,
+                     init_basic_update_block, init_small_update_block)
+
+
+class RAFTOutput(NamedTuple):
+    flow: jax.Array                      # [B, H, W, 2] final full-res flow
+    flow_iters: Optional[jax.Array]      # [iters, B, H, W, 2] or None
+    flow_lr: jax.Array                   # [B, H/8, W/8, 2] final low-res flow
+
+
+def init_raft(key: jax.Array, config: RAFTConfig) -> Dict[str, dict]:
+    kf, kc, ku = jax.random.split(key, 3)
+    corr_dim = config.corr_feature_dim
+    if config.small:
+        return {
+            "fnet": init_encoder(kf, config.fnet_dim, "instance", small=True),
+            "cnet": init_encoder(kc, config.cnet_dim, "none", small=True),
+            "update_block": init_small_update_block(
+                ku, corr_dim, config.hidden_dim, config.context_dim),
+        }
+    return {
+        "fnet": init_encoder(kf, config.fnet_dim, "instance", small=False),
+        "cnet": init_encoder(kc, config.cnet_dim, "batch", small=False),
+        "update_block": init_basic_update_block(
+            ku, corr_dim, config.hidden_dim, config.context_dim),
+    }
+
+
+def _preprocess(image: jax.Array, config: RAFTConfig) -> jax.Array:
+    # [0,1] -> [-1,1] (reference RAFT.py:53-59)
+    x = 2.0 * image - 1.0
+    if config.compute_dtype == "bfloat16":
+        x = x.astype(jnp.bfloat16)
+    return x
+
+
+def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
+                 config: RAFTConfig, iters: Optional[int] = None,
+                 train: bool = False, axis_name: Optional[str] = None,
+                 flow_init: Optional[jax.Array] = None,
+                 all_flows: Optional[bool] = None,
+                 rng: Optional[jax.Array] = None
+                 ) -> Tuple[RAFTOutput, Dict[str, dict]]:
+    """Run RAFT; returns (output, params-with-updated-BN-stats).
+
+    all_flows defaults to ``train`` — training needs every iteration's
+    upsampled flow for the sequence loss; inference only the last.
+    """
+    iters = config.iters if iters is None else iters
+    all_flows = train if all_flows is None else all_flows
+    cnet_norm = "none" if config.small else "batch"
+    update_fn = apply_small_update_block if config.small else apply_basic_update_block
+    cdt = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
+
+    orig_params = params
+    if config.compute_dtype == "bfloat16":
+        # One cast at the top; correlation and upsampling stay float32.
+        params = jax.tree.map(lambda a: a.astype(jnp.bfloat16)
+                              if a.dtype == jnp.float32 else a, params)
+
+    B, H, W, _ = image1.shape
+    if H % 8 or W % 8:
+        raise ValueError(
+            f"RAFT requires H and W divisible by 8, got {(H, W)}; pad or "
+            f"resize the inputs (see data.pipeline.pad_to_multiple).")
+    if image2.shape != image1.shape:
+        raise ValueError(f"image shapes differ: {image1.shape} vs {image2.shape}")
+    h, w = H // 8, W // 8
+
+    x1 = _preprocess(image1, config)
+    x2 = _preprocess(image2, config)
+
+    rngs = jax.random.split(rng, 2) if rng is not None else (None, None)
+    # Shared-weight feature encoder on both frames (reference RAFT.py:79-80):
+    # batch the two frames through one encoder call so XLA sees 2B-sized convs.
+    x12 = jnp.concatenate([x1, x2], axis=0)
+    fmaps, _ = apply_encoder(params["fnet"], x12, "instance", small=config.small,
+                             train=train, axis_name=axis_name,
+                             dropout=config.dropout, rng=rngs[0])
+    fmap1, fmap2 = fmaps[:B], fmaps[B:]
+    # correlation always in float32 (numerics policy)
+    fmap1c = fmap1.astype(jnp.float32)
+    fmap2c = fmap2.astype(jnp.float32)
+
+    if config.corr_impl == "dense":
+        pyramid = build_pyramid(fmap1c, fmap2c, config.corr_levels)
+        lookup = functools.partial(lookup_dense, pyramid, radius=config.corr_radius)
+    elif config.corr_impl == "blockwise":
+        f2_levels = fmap2_pyramid(fmap2c, config.corr_levels)
+        lookup = functools.partial(lookup_ondemand, fmap1c, f2_levels,
+                                   radius=config.corr_radius)
+    elif config.corr_impl == "pallas":
+        try:
+            from ..ops.corr_pallas import make_fused_lookup
+        except ImportError as e:
+            raise NotImplementedError(
+                "corr_impl='pallas' requires ops/corr_pallas.py (the fused "
+                "TPU kernel); use 'dense' or 'blockwise'.") from e
+        lookup = make_fused_lookup(fmap1c, fmap2c, config.corr_levels,
+                                   config.corr_radius)
+    else:
+        raise ValueError(config.corr_impl)
+
+    cnet, new_cnet_params = apply_encoder(
+        params["cnet"], x1, cnet_norm, small=config.small, train=train,
+        axis_name=axis_name, dropout=config.dropout, rng=rngs[1])
+    net = jnp.tanh(cnet[..., :config.hidden_dim])
+    inp = jax.nn.relu(cnet[..., config.hidden_dim:])
+
+    coords0 = coords_grid(B, h, w)
+    coords1 = coords0 if flow_init is None else coords0 + flow_init
+
+    def upsample(flow_lr: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
+        if config.small:
+            return upflow8(flow_lr.astype(jnp.float32), rescale=True)
+        return convex_upsample_flow(flow_lr.astype(jnp.float32),
+                                    mask.astype(jnp.float32))
+
+    def step(carry, _):
+        net, coords1, _ = carry
+        coords1 = jax.lax.stop_gradient(coords1)   # reference RAFT.py:93 / official
+        corr = lookup(coords=coords1).astype(cdt)
+        flow = (coords1 - coords0).astype(cdt)
+        net, mask, delta_flow = update_fn(params["update_block"], net, inp, corr, flow)
+        coords1 = coords1 + delta_flow.astype(jnp.float32)
+        out = upsample(coords1 - coords0, mask) if all_flows else None
+        return (net, coords1, mask), out
+
+    if config.remat_iters and train:
+        step = jax.checkpoint(step)
+
+    mask0 = None if config.small else jnp.zeros((B, h, w, 64 * 9), cdt)
+    (net, coords1, mask), ys = jax.lax.scan(
+        step, (net, coords1, mask0), None, length=iters)
+
+    flow_lr = coords1 - coords0
+    if all_flows:
+        flow_iters = ys                      # [iters, B, H, W, 2]
+        flow = flow_iters[-1]
+    else:
+        flow_iters = None
+        flow = upsample(flow_lr, mask)
+
+    new_params = dict(orig_params)
+    if train and not config.small:
+        # BN running stats updated in the cnet; restore original leaf dtypes.
+        new_params["cnet"] = jax.tree.map(
+            lambda new, old: new.astype(old.dtype),
+            new_cnet_params, orig_params["cnet"])
+    return RAFTOutput(flow=flow, flow_iters=flow_iters, flow_lr=flow_lr), new_params
+
+
+def make_inference_fn(config: RAFTConfig, iters: Optional[int] = None):
+    """A jittable (params, image1, image2) -> final flow function."""
+    def fn(params, image1, image2):
+        out, _ = raft_forward(params, image1, image2, config, iters=iters,
+                              train=False, all_flows=False)
+        return out.flow
+    return fn

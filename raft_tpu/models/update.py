@@ -1,0 +1,165 @@
+"""Iterative update blocks: motion encoders, ConvGRU / SepConvGRU, flow head,
+and the convex-upsampling mask head.
+
+Functional re-design of reference networks/model_utils.py:110-194 with the
+official RAFT channel plan; parameter dict keys mirror the official
+state_dict segments (``update_block.encoder.convc1`` etc.).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.conv import apply_conv, init_conv
+
+
+# ---------------------------------------------------------- motion encoders
+
+def init_basic_motion_encoder(key, corr_dim: int) -> dict:
+    k = jax.random.split(key, 5)
+    return {
+        "convc1": init_conv(k[0], 1, corr_dim, 256),
+        "convc2": init_conv(k[1], 3, 256, 192),
+        "convf1": init_conv(k[2], 7, 2, 128),
+        "convf2": init_conv(k[3], 3, 128, 64),
+        "conv": init_conv(k[4], 3, 192 + 64, 128 - 2),
+    }
+
+
+def apply_basic_motion_encoder(p: dict, flow: jax.Array, corr: jax.Array) -> jax.Array:
+    cor = jax.nn.relu(apply_conv(p["convc1"], corr))
+    cor = jax.nn.relu(apply_conv(p["convc2"], cor))
+    flo = jax.nn.relu(apply_conv(p["convf1"], flow))
+    flo = jax.nn.relu(apply_conv(p["convf2"], flo))
+    out = jax.nn.relu(apply_conv(p["conv"], jnp.concatenate([cor, flo], -1)))
+    return jnp.concatenate([out, flow], -1)          # 128 channels
+
+
+def init_small_motion_encoder(key, corr_dim: int) -> dict:
+    k = jax.random.split(key, 4)
+    return {
+        "convc1": init_conv(k[0], 1, corr_dim, 96),
+        "convf1": init_conv(k[1], 7, 2, 64),
+        "convf2": init_conv(k[2], 3, 64, 32),
+        "conv": init_conv(k[3], 3, 96 + 32, 80),
+    }
+
+
+def apply_small_motion_encoder(p: dict, flow: jax.Array, corr: jax.Array) -> jax.Array:
+    cor = jax.nn.relu(apply_conv(p["convc1"], corr))
+    flo = jax.nn.relu(apply_conv(p["convf1"], flow))
+    flo = jax.nn.relu(apply_conv(p["convf2"], flo))
+    out = jax.nn.relu(apply_conv(p["conv"], jnp.concatenate([cor, flo], -1)))
+    return jnp.concatenate([out, flow], -1)          # 82 channels
+
+
+# ------------------------------------------------------------------- GRUs
+
+def init_sep_conv_gru(key, hidden: int, input_dim: int) -> dict:
+    k = jax.random.split(key, 6)
+    hx = hidden + input_dim
+    return {
+        "convz1": init_conv(k[0], (1, 5), hx, hidden),
+        "convr1": init_conv(k[1], (1, 5), hx, hidden),
+        "convq1": init_conv(k[2], (1, 5), hx, hidden),
+        "convz2": init_conv(k[3], (5, 1), hx, hidden),
+        "convr2": init_conv(k[4], (5, 1), hx, hidden),
+        "convq2": init_conv(k[5], (5, 1), hx, hidden),
+    }
+
+
+def apply_sep_conv_gru(p: dict, h: jax.Array, x: jax.Array) -> jax.Array:
+    for suffix in ("1", "2"):        # horizontal (1x5) then vertical (5x1)
+        hx = jnp.concatenate([h, x], -1)
+        z = jax.nn.sigmoid(apply_conv(p["convz" + suffix], hx))
+        r = jax.nn.sigmoid(apply_conv(p["convr" + suffix], hx))
+        q = jnp.tanh(apply_conv(p["convq" + suffix], jnp.concatenate([r * h, x], -1)))
+        h = (1.0 - z) * h + z * q
+    return h
+
+
+def init_conv_gru(key, hidden: int, input_dim: int) -> dict:
+    k = jax.random.split(key, 3)
+    hx = hidden + input_dim
+    return {
+        "convz": init_conv(k[0], 3, hx, hidden),
+        "convr": init_conv(k[1], 3, hx, hidden),
+        "convq": init_conv(k[2], 3, hx, hidden),
+    }
+
+
+def apply_conv_gru(p: dict, h: jax.Array, x: jax.Array) -> jax.Array:
+    hx = jnp.concatenate([h, x], -1)
+    z = jax.nn.sigmoid(apply_conv(p["convz"], hx))
+    r = jax.nn.sigmoid(apply_conv(p["convr"], hx))
+    q = jnp.tanh(apply_conv(p["convq"], jnp.concatenate([r * h, x], -1)))
+    return (1.0 - z) * h + z * q
+
+
+# ------------------------------------------------------------- flow / mask
+
+def init_flow_head(key, in_dim: int, hidden: int) -> dict:
+    k1, k2 = jax.random.split(key)
+    return {"conv1": init_conv(k1, 3, in_dim, hidden),
+            "conv2": init_conv(k2, 3, hidden, 2)}
+
+
+def apply_flow_head(p: dict, x: jax.Array) -> jax.Array:
+    return apply_conv(p["conv2"], jax.nn.relu(apply_conv(p["conv1"], x)))
+
+
+def init_mask_head(key, in_dim: int) -> dict:
+    k1, k2 = jax.random.split(key)
+    return {"0": init_conv(k1, 3, in_dim, 256), "2": init_conv(k2, 1, 256, 64 * 9)}
+
+
+def apply_mask_head(p: dict, x: jax.Array) -> jax.Array:
+    m = jax.nn.relu(apply_conv(p["0"], x))
+    return 0.25 * apply_conv(p["2"], m)   # .25 scale as in official / reference
+
+
+# ------------------------------------------------------------ update blocks
+
+def init_basic_update_block(key, corr_dim: int, hidden_dim: int = 128,
+                            context_dim: int = 128) -> dict:
+    k = jax.random.split(key, 4)
+    return {
+        "encoder": init_basic_motion_encoder(k[0], corr_dim),
+        "gru": init_sep_conv_gru(k[1], hidden_dim, context_dim + 128),
+        "flow_head": init_flow_head(k[2], hidden_dim, 256),
+        "mask": init_mask_head(k[3], hidden_dim),
+    }
+
+
+def apply_basic_update_block(p: dict, net: jax.Array, inp: jax.Array,
+                             corr: jax.Array, flow: jax.Array
+                             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    motion = apply_basic_motion_encoder(p["encoder"], flow, corr)
+    x = jnp.concatenate([inp, motion], -1)
+    net = apply_sep_conv_gru(p["gru"], net, x)
+    delta_flow = apply_flow_head(p["flow_head"], net)
+    mask = apply_mask_head(p["mask"], net)
+    return net, mask, delta_flow
+
+
+def init_small_update_block(key, corr_dim: int, hidden_dim: int = 96,
+                            context_dim: int = 64) -> dict:
+    k = jax.random.split(key, 3)
+    return {
+        "encoder": init_small_motion_encoder(k[0], corr_dim),
+        "gru": init_conv_gru(k[1], hidden_dim, context_dim + 82),
+        "flow_head": init_flow_head(k[2], hidden_dim, 128),
+    }
+
+
+def apply_small_update_block(p: dict, net: jax.Array, inp: jax.Array,
+                             corr: jax.Array, flow: jax.Array
+                             ) -> Tuple[jax.Array, Optional[jax.Array], jax.Array]:
+    motion = apply_small_motion_encoder(p["encoder"], flow, corr)
+    x = jnp.concatenate([inp, motion], -1)
+    net = apply_conv_gru(p["gru"], net, x)
+    delta_flow = apply_flow_head(p["flow_head"], net)
+    return net, None, delta_flow

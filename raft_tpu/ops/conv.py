@@ -1,0 +1,71 @@
+"""2-D convolution helpers, NHWC activations / HWIO kernels.
+
+The reference builds its convs with tensorpack ``Conv2D(padding='same')``
+(e.g. reference networks/model_utils.py:22,70), whose TF "SAME" padding is
+*asymmetric* for stride-2 layers — one of the sources of its acknowledged
+divergence from the official weights (reference readme.md:45).  Here padding
+is explicit and symmetric (floor(k/2) on each side), exactly matching the
+PyTorch ``nn.Conv2d(padding=k//2)`` layers the official checkpoints were
+trained with.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+_DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+KernelSize = Union[int, Tuple[int, int]]
+
+
+def _pair(k: KernelSize) -> Tuple[int, int]:
+    return (k, k) if isinstance(k, int) else tuple(k)
+
+
+def conv2d(x: jax.Array, w: jax.Array, b: jax.Array | None = None,
+           stride: int = 1, compute_dtype=None) -> jax.Array:
+    """Convolution with symmetric torch-style padding.
+
+    x: [B, H, W, Cin]; w: [kh, kw, Cin, Cout]; b: [Cout] or None.
+    """
+    kh, kw = w.shape[0], w.shape[1]
+    pad = ((kh // 2, kh // 2), (kw // 2, kw // 2))
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        w = w.astype(compute_dtype)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=pad,
+        dimension_numbers=_DIMNUMS)
+    if b is not None:
+        out = out + b.astype(out.dtype)
+    return out
+
+
+def init_conv(key: jax.Array, k: KernelSize, c_in: int, c_out: int,
+              bias: bool = True, dtype=jnp.float32) -> dict:
+    """Kaiming-normal (fan_out, relu) init, the official RAFT scheme."""
+    kh, kw = _pair(k)
+    fan_out = kh * kw * c_out
+    std = (2.0 / fan_out) ** 0.5
+    p = {"w": std * jax.random.normal(key, (kh, kw, c_in, c_out), dtype)}
+    if bias:
+        p["b"] = jnp.zeros((c_out,), dtype)
+    return p
+
+
+def apply_conv(params: dict, x: jax.Array, stride: int = 1, compute_dtype=None) -> jax.Array:
+    return conv2d(x, params["w"], params.get("b"), stride=stride, compute_dtype=compute_dtype)
+
+
+def avg_pool2d(x: jax.Array, window: int = 2, stride: int = 2) -> jax.Array:
+    """Average pooling over H, W of [B, H, W, C] (VALID padding), as the
+    reference's pyramid pooling uses (reference model_utils.py:218)."""
+    out = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding="VALID")
+    return out / float(window * window)

@@ -1,0 +1,96 @@
+"""Bilinear grid sampling with exact PyTorch ``align_corners=True`` semantics.
+
+The reference's sampler (reference networks/utils.py:39-103) clips corner
+indices to the image bounds and uses a weight trick that diverges from
+PyTorch's ``F.grid_sample`` at borders — a divergence its author acknowledged
+as unfinished (reference readme.md:11).  This module fixes that: it implements
+both ``zeros`` (PyTorch default, what official RAFT uses) and ``border``
+padding exactly, operating directly in *pixel* coordinates (the convention the
+RAFT lookup uses), NHWC.
+
+TPU notes: the gather is expressed as ``take_along_axis`` over a flattened
+spatial axis, which XLA lowers to a single gather per corner rather than the
+reference's per-point ``gather_nd``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _gather_pixels(img_flat: jax.Array, idx: jax.Array) -> jax.Array:
+    """img_flat: [B, H*W, C]; idx: int32 [B, N] -> [B, N, C]."""
+    return jnp.take_along_axis(img_flat, idx[..., None], axis=1)
+
+
+def grid_sample(img: jax.Array, coords: jax.Array, padding_mode: str = "zeros") -> jax.Array:
+    """Sample ``img`` bilinearly at pixel coordinates ``coords``.
+
+    Args:
+      img: [B, H, W, C] input.
+      coords: [B, ..., 2] pixel coordinates, last axis (x, y).  Pixel (0, 0)
+        is the center of the top-left input pixel — i.e. PyTorch
+        ``align_corners=True`` after unnormalizing the grid.
+      padding_mode: 'zeros' (out-of-range samples contribute 0, PyTorch
+        default) or 'border' (coordinates clamped to the valid range).
+
+    Returns:
+      [B, ..., C] sampled values.
+    """
+    B, H, W, C = img.shape
+    out_shape = coords.shape[:-1] + (C,)
+    coords = coords.reshape(B, -1, 2)
+    x = coords[..., 0].astype(jnp.float32)
+    y = coords[..., 1].astype(jnp.float32)
+
+    if padding_mode == "border":
+        x = jnp.clip(x, 0.0, W - 1)
+        y = jnp.clip(y, 0.0, H - 1)
+    elif padding_mode != "zeros":
+        raise ValueError(f"unknown padding_mode {padding_mode!r}")
+
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    fx = x - x0
+    fy = y - y0
+    x0i = x0.astype(jnp.int32)
+    y0i = y0.astype(jnp.int32)
+
+    img_flat = img.reshape(B, H * W, C)
+
+    def corner(ix, iy):
+        valid = (ix >= 0) & (ix <= W - 1) & (iy >= 0) & (iy <= H - 1)
+        idx = jnp.clip(iy, 0, H - 1) * W + jnp.clip(ix, 0, W - 1)
+        v = _gather_pixels(img_flat, idx)
+        if padding_mode == "zeros":
+            v = jnp.where(valid[..., None], v, 0.0)
+        return v
+
+    va = corner(x0i, y0i)
+    vb = corner(x0i + 1, y0i)
+    vc = corner(x0i, y0i + 1)
+    vd = corner(x0i + 1, y0i + 1)
+
+    wa = ((1.0 - fx) * (1.0 - fy))[..., None]
+    wb = (fx * (1.0 - fy))[..., None]
+    wc = ((1.0 - fx) * fy)[..., None]
+    wd = (fx * fy)[..., None]
+
+    out = wa * va + wb * vb + wc * vc + wd * vd
+    return out.reshape(out_shape)
+
+
+def grid_sample_normalized(img: jax.Array, grid: jax.Array, padding_mode: str = "zeros",
+                           align_corners: bool = True) -> jax.Array:
+    """PyTorch-convention entry point: ``grid`` in [-1, 1], last axis (x, y)."""
+    B, H, W, C = img.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+    if align_corners:
+        px = (gx + 1.0) * 0.5 * (W - 1)
+        py = (gy + 1.0) * 0.5 * (H - 1)
+    else:
+        px = ((gx + 1.0) * W - 1.0) * 0.5
+        py = ((gy + 1.0) * H - 1.0) * 0.5
+    return grid_sample(img, jnp.stack([px, py], axis=-1), padding_mode=padding_mode)

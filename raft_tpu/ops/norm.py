@@ -1,0 +1,96 @@
+"""Normalization layers, NHWC-native.
+
+Replaces the reference's norm stack (reference networks/model_utils.py:6-17):
+tensorpack InstanceNorm/BatchNorm plus a GroupNorm that assumed NCHW while the
+model ran NHWC, carried a dead ``chan == 728`` hack, and was never actually
+selected (reference common/groupnorm.py:16-20, SURVEY.md §2).  All four modes
+(``instance``/``batch``/``group``/``none``) are first-class and NHWC here.
+
+Batch norm is functional: training mode returns updated running statistics,
+and an optional ``axis_name`` makes it a cross-replica (synchronized) batch
+norm via ``pmean`` — the TPU-native equivalent of what a multi-GPU trainer
+would need.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-5
+
+
+def instance_norm(x: jax.Array, gamma: jax.Array | None = None,
+                  beta: jax.Array | None = None, eps: float = EPS) -> jax.Array:
+    """Per-sample, per-channel normalization over H, W.
+
+    The reference uses affine-free instance norm for the feature encoder
+    (``center=False, scale=False``, reference model_utils.py:13), matching
+    PyTorch's default ``nn.InstanceNorm2d(affine=False)``.
+    """
+    mean = jnp.mean(x, axis=(1, 2), keepdims=True)
+    var = jnp.var(x, axis=(1, 2), keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if gamma is not None:
+        out = out * gamma
+    if beta is not None:
+        out = out + beta
+    return out
+
+
+def group_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
+               num_groups: int, eps: float = EPS) -> jax.Array:
+    """GroupNorm over channel groups of NHWC input."""
+    B, H, W, C = x.shape
+    assert C % num_groups == 0, (C, num_groups)
+    xg = x.reshape(B, H, W, num_groups, C // num_groups)
+    mean = jnp.mean(xg, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(xg, axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + eps)
+    return xg.reshape(B, H, W, C) * gamma + beta
+
+
+def init_batch_norm(c: int, dtype=jnp.float32) -> dict:
+    return {
+        "gamma": jnp.ones((c,), dtype),
+        "beta": jnp.zeros((c,), dtype),
+        "mean": jnp.zeros((c,), dtype),
+        "var": jnp.ones((c,), dtype),
+    }
+
+
+def batch_norm(params: dict, x: jax.Array, train: bool = False,
+               momentum: float = 0.1, eps: float = EPS,
+               axis_name: Optional[str] = None) -> Tuple[jax.Array, dict]:
+    """Batch norm; returns (output, possibly-updated running-stat params).
+
+    With ``axis_name`` set (inside shard_map/pmap) batch statistics are
+    averaged across replicas — synchronized BN over the data-parallel axis.
+    """
+    if train:
+        n = x.shape[0] * x.shape[1] * x.shape[2]
+        mean = jnp.mean(x, axis=(0, 1, 2))
+        mean2 = jnp.mean(jnp.square(x), axis=(0, 1, 2))
+        if axis_name is not None:
+            mean = jax.lax.pmean(mean, axis_name)
+            mean2 = jax.lax.pmean(mean2, axis_name)
+            n = n * jax.lax.psum(1, axis_name)
+        var = mean2 - jnp.square(mean)
+        # running update uses the unbiased variance (n/(n-1)), torch semantics;
+        # normalization itself uses the biased batch variance
+        nf = jnp.asarray(n, jnp.float32)
+        var_unbiased = var * (nf / jnp.maximum(nf - 1.0, 1.0))
+        new_params = dict(params)
+        new_params["mean"] = (1.0 - momentum) * params["mean"] + momentum * mean
+        new_params["var"] = (1.0 - momentum) * params["var"] + momentum * var_unbiased
+    else:
+        mean, var = params["mean"], params["var"]
+        new_params = params
+    out = (x - mean) * jax.lax.rsqrt(var + eps) * params["gamma"] + params["beta"]
+    return out, new_params
+
+
+def init_group_norm(c: int, dtype=jnp.float32) -> dict:
+    return {"gamma": jnp.ones((c,), dtype), "beta": jnp.zeros((c,), dtype)}

@@ -1,0 +1,42 @@
+"""Learned convex upsampling of flow fields.
+
+Replaces reference networks/RAFT.py:119-134 (``upsample_flow``): each
+full-resolution pixel is a softmax-convex combination of the 3x3 neighborhood
+of its coarse cell, with weights predicted by the mask head.  The reference
+uses ``tf.extract_image_patches``; here the 9 taps are 9 static pad+slice
+shifts, which XLA fuses — no gather, no patch materialization beyond [..., 9].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _shift_stack_3x3(x: jax.Array) -> jax.Array:
+    """[B, H, W, C] -> [B, H, W, 9, C]: zero-padded 3x3 neighborhoods,
+    tap order row-major (dy, dx) to match both ``tf.extract_image_patches``
+    and PyTorch ``F.unfold``."""
+    B, H, W, C = x.shape
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    taps = [xp[:, dy:dy + H, dx:dx + W, :] for dy in range(3) for dx in range(3)]
+    return jnp.stack(taps, axis=3)
+
+
+def convex_upsample_flow(flow: jax.Array, mask: jax.Array, factor: int = 8) -> jax.Array:
+    """Upsample [B, H, W, 2] flow to [B, 8H, 8W, 2] with convex weights.
+
+    mask: [B, H, W, 9 * factor**2] raw logits from the mask head, channel
+    factoring (k, r, c) with k the 3x3 tap index — the layout shared by the
+    official mask head and the reference's reshape (reference RAFT.py:125).
+    Flow values are multiplied by ``factor`` (coarse pixels -> fine pixels).
+    """
+    B, H, W, _ = flow.shape
+    f = factor
+    m = mask.reshape(B, H, W, 9, f, f)
+    m = jax.nn.softmax(m, axis=3)
+
+    patches = _shift_stack_3x3(float(f) * flow)          # [B, H, W, 9, 2]
+    up = jnp.einsum("bhwkrc,bhwkd->bhwrcd", m, patches)  # [B, H, W, f, f, 2]
+    up = up.transpose(0, 1, 3, 2, 4, 5)                  # [B, H, f, W, f, 2]
+    return up.reshape(B, H * f, W * f, 2)

@@ -1,0 +1,17 @@
+"""Test configuration: run everything on CPU with 8 virtual devices so the
+multi-device sharding paths are exercised without TPU hardware (SURVEY.md §4).
+
+Note: the environment pins JAX_PLATFORMS=axon (the TPU tunnel) and re-sets it
+at interpreter startup, so the env var alone is not enough — we must override
+via jax.config after import, before any backend initialization.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

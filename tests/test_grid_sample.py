@@ -1,0 +1,60 @@
+"""Golden-parity tests for grid sampling vs PyTorch F.grid_sample —
+the exactness the reference never achieved (reference readme.md:11)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from raft_tpu.ops import grid_sample, grid_sample_normalized
+
+
+def _torch_grid_sample(img_nhwc, grid_norm, padding_mode, align_corners=True):
+    img_t = torch.from_numpy(np.transpose(img_nhwc, (0, 3, 1, 2)))
+    grid_t = torch.from_numpy(grid_norm)
+    out = F.grid_sample(img_t, grid_t, mode="bilinear",
+                        padding_mode=padding_mode, align_corners=align_corners)
+    return np.transpose(out.numpy(), (0, 2, 3, 1))
+
+
+@pytest.mark.parametrize("padding_mode", ["zeros", "border"])
+@pytest.mark.parametrize("align_corners", [True, False])
+def test_matches_torch(padding_mode, align_corners):
+    rng = np.random.RandomState(0)
+    B, H, W, C = 2, 13, 17, 3
+    GH, GW = 9, 11
+    img = rng.randn(B, H, W, C).astype(np.float32)
+    # include in-range, border-exact and far out-of-range points
+    grid = rng.uniform(-1.6, 1.6, size=(B, GH, GW, 2)).astype(np.float32)
+    grid[0, 0, 0] = [-1.0, -1.0]
+    grid[0, 0, 1] = [1.0, 1.0]
+    grid[0, 1, 0] = [0.0, 1.0]
+
+    want = _torch_grid_sample(img, grid, padding_mode, align_corners)
+    got = grid_sample_normalized(jnp.asarray(img), jnp.asarray(grid),
+                                 padding_mode=padding_mode,
+                                 align_corners=align_corners)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5, rtol=1e-5)
+
+
+def test_pixel_coords_integer_points_exact():
+    rng = np.random.RandomState(1)
+    img = rng.randn(1, 8, 10, 2).astype(np.float32)
+    ys, xs = np.meshgrid(np.arange(8), np.arange(10), indexing="ij")
+    coords = np.stack([xs, ys], axis=-1).astype(np.float32)[None]
+    out = grid_sample(jnp.asarray(img), jnp.asarray(coords))
+    np.testing.assert_allclose(np.asarray(out), img, atol=1e-6)
+
+
+def test_gradient_flows():
+    import jax
+    img = jnp.ones((1, 6, 6, 1))
+    coords = jnp.full((1, 4, 4, 2), 2.5)
+
+    def f(c):
+        return jnp.sum(grid_sample(img, c) ** 2)
+
+    g = jax.grad(f)(coords)
+    assert np.all(np.isfinite(np.asarray(g)))

@@ -1,0 +1,134 @@
+"""Model-level tests: shapes, jit, free batch/resolution, scan-vs-unroll
+equivalence, training-mode outputs (SURVEY.md §4 strategy)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models import init_raft, raft_forward
+from raft_tpu.models.raft import make_inference_fn
+
+
+def _params_and_images(config, B=1, H=64, W=96, seed=0):
+    key = jax.random.PRNGKey(seed)
+    params = init_raft(key, config)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed + 1))
+    im1 = jax.random.uniform(k1, (B, H, W, 3))
+    im2 = jax.random.uniform(k2, (B, H, W, 3))
+    return params, im1, im2
+
+
+@pytest.mark.parametrize("small", [False, True])
+def test_forward_shapes(small):
+    config = RAFTConfig.small_model(iters=3) if small else RAFTConfig.full(iters=3)
+    params, im1, im2 = _params_and_images(config)
+    out, _ = raft_forward(params, im1, im2, config)
+    assert out.flow.shape == (1, 64, 96, 2)
+    assert out.flow_lr.shape == (1, 8, 12, 2)
+    assert out.flow_iters is None
+    assert np.all(np.isfinite(np.asarray(out.flow)))
+
+
+def test_param_count_full():
+    """Official RAFT: 5.3M params (full), ~1.0M (small) — BASELINE.md."""
+    config = RAFTConfig.full()
+    params = init_raft(jax.random.PRNGKey(0), config)
+    trainable = sum(x.size for x in jax.tree.leaves(params))
+    # running BN stats included; subtract them for the trainable count
+    assert 5.1e6 < trainable < 5.5e6, trainable
+
+    small = init_raft(jax.random.PRNGKey(0), RAFTConfig.small_model())
+    n_small = sum(x.size for x in jax.tree.leaves(small))
+    assert 0.9e6 < n_small < 1.1e6, n_small
+
+
+def test_free_batch_and_resolution():
+    config = RAFTConfig.small_model(iters=2)
+    params, im1, im2 = _params_and_images(config, B=2, H=48, W=64)
+    out, _ = raft_forward(params, im1, im2, config)
+    assert out.flow.shape == (2, 48, 64, 2)
+    _, im1b, im2b = _params_and_images(config, B=3, H=64, W=48)
+    out2, _ = raft_forward(params, im1b, im2b, config)
+    assert out2.flow.shape == (3, 64, 48, 2)
+
+
+def test_jit_and_iters_override():
+    config = RAFTConfig.full(iters=2)
+    params, im1, im2 = _params_and_images(config)
+    fn = jax.jit(make_inference_fn(config))
+    flow = fn(params, im1, im2)
+    assert flow.shape == (1, 64, 96, 2)
+
+    out4, _ = raft_forward(params, im1, im2, config, iters=4)
+    out2, _ = raft_forward(params, im1, im2, config, iters=2)
+    assert not np.allclose(np.asarray(out4.flow), np.asarray(out2.flow))
+    # jit-vs-eager tolerance: XLA reassociates fp32 reductions through the
+    # recurrent loop, so bit-exactness is not expected
+    np.testing.assert_allclose(np.asarray(out2.flow),
+                               np.asarray(fn(params, im1, im2)),
+                               atol=2e-2, rtol=1e-3)
+
+
+@pytest.mark.parametrize("impl", ["blockwise"])
+def test_corr_impls_agree(impl):
+    base = RAFTConfig.full(iters=3)
+    other = RAFTConfig.full(iters=3, corr_impl=impl)
+    params, im1, im2 = _params_and_images(base)
+    out_a, _ = raft_forward(params, im1, im2, base)
+    out_b, _ = raft_forward(params, im1, im2, other)
+    # the raw lookups agree to ~1e-6 (test_corr); recurrence amplifies the
+    # different-summation-order noise, so compare relative to flow magnitude
+    scale = np.abs(np.asarray(out_a.flow)).mean()
+    diff = np.abs(np.asarray(out_a.flow) - np.asarray(out_b.flow)).max()
+    assert diff / scale < 1e-3, (diff, scale)
+
+
+def test_train_mode_outputs_all_iters():
+    config = RAFTConfig.full(iters=3)
+    params, im1, im2 = _params_and_images(config, B=2, H=48, W=64)
+    out, new_params = raft_forward(params, im1, im2, config, train=True)
+    assert out.flow_iters.shape == (3, 2, 48, 64, 2)
+    # BN running stats must have moved
+    old_mean = params["cnet"]["norm1"]["mean"]
+    new_mean = new_params["cnet"]["norm1"]["mean"]
+    assert not np.allclose(np.asarray(old_mean), np.asarray(new_mean))
+
+
+def test_gradients_flow_and_finite():
+    config = RAFTConfig.full(iters=2)
+    params, im1, im2 = _params_and_images(config, H=48, W=64)
+
+    def loss_fn(p):
+        out, _ = raft_forward(p, im1, im2, config, train=True)
+        return jnp.mean(jnp.abs(out.flow_iters)) * 1e3
+
+    grads = jax.grad(loss_fn)(params)
+    leaves = jax.tree.leaves(grads)
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in leaves)
+    # the update block must receive gradient
+    gnorm = float(jnp.linalg.norm(grads["update_block"]["flow_head"]["conv2"]["w"]))
+    assert gnorm > 0.0
+
+
+def test_flow_init_warm_start():
+    config = RAFTConfig.small_model(iters=2)
+    params, im1, im2 = _params_and_images(config)
+    init = jnp.ones((1, 8, 12, 2))
+    out, _ = raft_forward(params, im1, im2, config, flow_init=init)
+    out0, _ = raft_forward(params, im1, im2, config)
+    assert not np.allclose(np.asarray(out.flow), np.asarray(out0.flow))
+
+
+def test_bfloat16_compute():
+    config = RAFTConfig.full(iters=2, compute_dtype="bfloat16")
+    params, im1, im2 = _params_and_images(config)
+    out, _ = raft_forward(params, im1, im2, config)
+    assert out.flow.dtype == jnp.float32
+    ref, _ = raft_forward(params, im1, im2, RAFTConfig.full(iters=2))
+    # bf16 compute should stay in the same ballpark as fp32
+    diff = np.abs(np.asarray(out.flow) - np.asarray(ref.flow)).mean()
+    scale = np.abs(np.asarray(ref.flow)).mean() + 1e-6
+    assert diff / scale < 0.5, (diff, scale)

@@ -1,0 +1,134 @@
+"""Torch-golden tests for resize, norms, conv padding, and convex upsample."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops import (batch_norm, conv2d, convex_upsample_flow, coords_grid,
+                          group_norm, init_batch_norm, instance_norm,
+                          resize_bilinear_align_corners, upflow8)
+
+
+def test_coords_grid():
+    g = np.asarray(coords_grid(2, 3, 4))
+    assert g.shape == (2, 3, 4, 2)
+    assert g[0, 1, 2, 0] == 2  # x
+    assert g[0, 1, 2, 1] == 1  # y
+    assert np.array_equal(g[0], g[1])
+
+
+def test_resize_align_corners_matches_torch():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 5, 7, 3).astype(np.float32)
+    want = F.interpolate(torch.from_numpy(x.transpose(0, 3, 1, 2)),
+                         size=(40, 56), mode="bilinear", align_corners=True)
+    want = want.numpy().transpose(0, 2, 3, 1)
+    got = np.asarray(resize_bilinear_align_corners(jnp.asarray(x), 40, 56))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_upflow8_matches_official_semantics():
+    rng = np.random.RandomState(1)
+    flow = rng.randn(1, 6, 8, 2).astype(np.float32)
+    want = 8.0 * F.interpolate(torch.from_numpy(flow.transpose(0, 3, 1, 2)),
+                               size=(48, 64), mode="bilinear",
+                               align_corners=True).numpy().transpose(0, 2, 3, 1)
+    got = np.asarray(upflow8(jnp.asarray(flow)))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_instance_norm_matches_torch():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 9, 11, 5).astype(np.float32)
+    want = F.instance_norm(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    want = want.numpy().transpose(0, 2, 3, 1)
+    got = np.asarray(instance_norm(jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_group_norm_matches_torch():
+    rng = np.random.RandomState(3)
+    C, G = 24, 8
+    x = rng.randn(2, 7, 6, C).astype(np.float32)
+    gamma = rng.randn(C).astype(np.float32)
+    beta = rng.randn(C).astype(np.float32)
+    want = F.group_norm(torch.from_numpy(x.transpose(0, 3, 1, 2)), G,
+                        torch.from_numpy(gamma), torch.from_numpy(beta))
+    want = want.numpy().transpose(0, 2, 3, 1)
+    got = np.asarray(group_norm(jnp.asarray(x), jnp.asarray(gamma),
+                                jnp.asarray(beta), G))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_batch_norm_inference_and_train():
+    rng = np.random.RandomState(4)
+    C = 6
+    x = rng.randn(4, 5, 5, C).astype(np.float32)
+    params = init_batch_norm(C)
+    params["mean"] = jnp.asarray(rng.randn(C).astype(np.float32))
+    params["var"] = jnp.asarray(rng.rand(C).astype(np.float32) + 0.5)
+    params["gamma"] = jnp.asarray(rng.randn(C).astype(np.float32))
+    params["beta"] = jnp.asarray(rng.randn(C).astype(np.float32))
+
+    bn = torch.nn.BatchNorm2d(C, eps=1e-5, momentum=0.1)
+    bn.running_mean = torch.from_numpy(np.asarray(params["mean"]).copy())
+    bn.running_var = torch.from_numpy(np.asarray(params["var"]).copy())
+    bn.weight.data = torch.from_numpy(np.asarray(params["gamma"]).copy())
+    bn.bias.data = torch.from_numpy(np.asarray(params["beta"]).copy())
+
+    bn.eval()
+    want = bn(torch.from_numpy(x.transpose(0, 3, 1, 2))).detach().numpy().transpose(0, 2, 3, 1)
+    got, new_params = batch_norm(params, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
+    assert new_params is params
+
+    bn.train()
+    want_tr = bn(torch.from_numpy(x.transpose(0, 3, 1, 2))).detach().numpy().transpose(0, 2, 3, 1)
+    got_tr, new_params = batch_norm(params, jnp.asarray(x), train=True)
+    np.testing.assert_allclose(np.asarray(got_tr), want_tr, atol=1e-3, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(new_params["mean"]),
+                               bn.running_mean.numpy(), atol=1e-4, rtol=1e-3)
+
+
+@pytest.mark.parametrize("k,stride", [(7, 2), (3, 1), (3, 2), (1, 1), ((1, 5), 1), ((5, 1), 1)])
+def test_conv2d_matches_torch_padding(k, stride):
+    rng = np.random.RandomState(5)
+    kh, kw = (k, k) if isinstance(k, int) else k
+    B, H, W, Ci, Co = 2, 12, 14, 3, 4
+    x = rng.randn(B, H, W, Ci).astype(np.float32)
+    w = rng.randn(kh, kw, Ci, Co).astype(np.float32)
+    b = rng.randn(Co).astype(np.float32)
+
+    want = F.conv2d(torch.from_numpy(x.transpose(0, 3, 1, 2)),
+                    torch.from_numpy(w.transpose(3, 2, 0, 1)),
+                    torch.from_numpy(b), stride=stride,
+                    padding=(kh // 2, kw // 2))
+    want = want.numpy().transpose(0, 2, 3, 1)
+    got = np.asarray(conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), stride=stride))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_convex_upsample_matches_torch_unfold():
+    """Oracle: the official RAFT upsample_flow math written in torch."""
+    rng = np.random.RandomState(6)
+    B, H, W = 2, 5, 6
+    flow = rng.randn(B, H, W, 2).astype(np.float32)
+    mask = rng.randn(B, H, W, 9 * 64).astype(np.float32)
+
+    # torch oracle (official layout: mask.view(N, 1, 9, 8, 8, H, W))
+    flow_t = torch.from_numpy(flow.transpose(0, 3, 1, 2))
+    mask_t = torch.from_numpy(mask.transpose(0, 3, 1, 2))
+    m = mask_t.view(B, 1, 9, 8, 8, H, W)
+    m = torch.softmax(m, dim=2)
+    up = F.unfold(8 * flow_t, [3, 3], padding=1)
+    up = up.view(B, 2, 9, 1, 1, H, W)
+    up = torch.sum(m * up, dim=2)
+    up = up.permute(0, 1, 4, 2, 5, 3)
+    want = up.reshape(B, 2, 8 * H, 8 * W).numpy().transpose(0, 2, 3, 1)
+
+    got = np.asarray(convex_upsample_flow(jnp.asarray(flow), jnp.asarray(mask)))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
