@@ -1,0 +1,114 @@
+"""Benchmark: image-pairs/sec/chip, raft-things (full model), 12 GRU
+iterations — the BASELINE.json target metric.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "pairs/sec/chip", "vs_baseline": R}
+
+vs_baseline: the reference publishes no numbers (BASELINE.md — no EPE code,
+no benchmarks, flops mode crashed), so the baseline here is the *reference's
+configuration* run on the same hardware by this framework: dense correlation
+exactly as reference model_utils.py:199-221 materializes it, at the
+reference's hardcoded 20 iterations (reference RAFT.py:33).  value/vs stays
+honest: same hardware, reference algorithm vs our tuned path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _measure(fn, args, warmup: int = 2, reps: int = 5) -> float:
+    """Median wall time per call (seconds)."""
+    import numpy as np
+    for _ in range(warmup):
+        jax_block(fn(*args))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax_block(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def jax_block(x):
+    import jax
+    jax.block_until_ready(x)
+    return x
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", type=int, nargs=2, default=(432, 1024),
+                   metavar=("H", "W"))
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--iters", type=int, default=12)
+    p.add_argument("--quick", action="store_true",
+                   help="small size for CI smoke (128x256)")
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--impl", default=None,
+                   help="force a corr impl instead of auto-picking the best")
+    args = p.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import init_raft
+    from raft_tpu.models.raft import make_inference_fn
+
+    if args.quick:
+        args.size = (128, 256)
+
+    H, W = args.size
+    B = args.batch
+    dev = jax.devices()[0]
+    print(f"# device: {dev.platform}:{dev.device_kind}  input {B}x{H}x{W}  "
+          f"iters {args.iters}", file=sys.stderr)
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    im1 = jax.random.uniform(k1, (B, H, W, 3), jnp.float32)
+    im2 = jax.random.uniform(k2, (B, H, W, 3), jnp.float32)
+
+    def throughput(config, iters) -> float:
+        params = init_raft(jax.random.PRNGKey(0), config)
+        fn = jax.jit(make_inference_fn(config, iters=iters))
+        dt = _measure(fn, (params, im1, im2))
+        return B / dt
+
+    # candidate tuned configurations; best one is the headline number
+    candidates = ([args.impl] if args.impl else ["dense", "blockwise"])
+    best_name, best = None, -1.0
+    for name in candidates:
+        try:
+            cfg = RAFTConfig.full(corr_impl=name, compute_dtype="bfloat16")
+            tput = throughput(cfg, args.iters)
+            print(f"# {name}+bf16: {tput:.3f} pairs/s", file=sys.stderr)
+            if tput > best:
+                best_name, best = f"{name}+bf16", tput
+        except Exception as e:    # noqa: BLE001 — keep benchmarking others
+            print(f"# {name} failed: {type(e).__name__}: {e}", file=sys.stderr)
+
+    # reference configuration: dense fp32 corr volume, hardcoded 20 iters
+    ref_cfg = RAFTConfig.full(corr_impl="dense", compute_dtype="float32")
+    ref = throughput(ref_cfg, 20)
+    print(f"# reference-config (dense fp32, 20 iters): {ref:.3f} pairs/s",
+          file=sys.stderr)
+
+    result = {
+        "metric": (f"raft-things inference throughput @ {args.iters} GRU iters, "
+                   f"{H}x{W} ({best_name})"),
+        "value": round(best, 4),
+        "unit": "pairs/sec/chip",
+        "vs_baseline": round(best / ref, 4) if ref > 0 else None,
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

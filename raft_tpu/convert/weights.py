@@ -1,0 +1,233 @@
+"""Checkpoint conversion: official PyTorch ``.pth`` / reference ``.npz``
+-> raft-tpu parameter pytrees.
+
+The reference's checkpoint format contract is "npz keys = TF variable names
+chosen to mirror the PyTorch state_dict" (reference infer_raft.py:77,
+readme.md:28; SURVEY.md §3.4).  Our pytree keys already mirror the PyTorch
+path segments, so conversion is a pure leaf-name + layout map:
+
+  torch 'fnet.layer1.0.conv1.weight'  [O,I,kH,kW] -> ['fnet']['layer1']['0']['conv1']['w']  [kH,kW,I,O]
+  torch 'cnet.norm1.weight'                       -> ['cnet']['norm1']['gamma']
+  tensorpack 'fnet/layer1/0/conv1/W'  [kH,kW,I,O] -> same leaf, no transpose
+
+Channel order: the official weights were trained on RGB input; the reference
+feeds BGR (reference RAFT.py:13).  ``swap_input_channels=True`` permutes the
+first conv's input channels of fnet and cnet so the converted model accepts
+BGR directly (what RAFTConfig.channel_order='bgr' expects).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+import numpy as np
+
+_TORCH_NORM_LEAVES = {
+    "weight": "gamma", "bias": "beta",
+    "running_mean": "mean", "running_var": "var",
+}
+_TP_LEAVES = {
+    "W": "w", "b": "b", "gamma": "gamma", "beta": "beta",
+    "mean/EMA": "mean", "variance/EMA": "var",
+}
+
+
+def _set_path(tree: dict, parts, leaf_name: str, value: np.ndarray) -> None:
+    node = tree
+    for p in parts:
+        node = node.setdefault(p, {})
+    node[leaf_name] = value
+
+
+def from_torch_state_dict(state_dict: Mapping[str, np.ndarray],
+                          swap_input_channels: bool = False,
+                          strict: bool = True) -> Dict[str, dict]:
+    """Convert a torch state_dict (tensors or ndarrays) to a params pytree.
+
+    Handles the official RAFT naming, with or without the DataParallel
+    ``module.`` prefix; conv kernels are transposed OIHW -> HWIO.
+    """
+    params: Dict[str, dict] = {}
+    skipped = []
+    for name, value in state_dict.items():
+        arr = np.asarray(getattr(value, "numpy", lambda: value)())
+        if name.startswith("module."):
+            name = name[len("module."):]
+        parts = name.split(".")
+        leaf = parts[-1]
+        if leaf == "num_batches_tracked":
+            continue
+        if arr.ndim == 4 and leaf == "weight":            # conv kernel
+            _set_path(params, parts[:-1], "w", arr.transpose(2, 3, 1, 0))
+        elif leaf == "weight" and arr.ndim == 1:          # norm gamma
+            _set_path(params, parts[:-1], "gamma", arr)
+        elif leaf == "bias" and arr.ndim == 1:
+            # conv bias vs norm beta: decide by sibling weight rank later;
+            # record as 'b' and fix up in _fix_biases
+            _set_path(params, parts[:-1], "b", arr)
+        elif leaf in _TORCH_NORM_LEAVES:
+            _set_path(params, parts[:-1], _TORCH_NORM_LEAVES[leaf], arr)
+        else:
+            skipped.append(name)
+    if skipped and strict:
+        raise ValueError(f"unrecognized state_dict entries: {skipped}")
+    _fix_biases(params)
+    if swap_input_channels:
+        swap_rgb_bgr(params)
+    return params
+
+
+def _fix_biases(node: dict) -> None:
+    """A module with 'gamma' is a norm layer: its 'b' is really 'beta'."""
+    if "gamma" in node and "b" in node and "w" not in node:
+        node["beta"] = node.pop("b")
+    for v in node.values():
+        if isinstance(v, dict):
+            _fix_biases(v)
+
+
+def swap_rgb_bgr(params: Dict[str, dict]) -> None:
+    """In-place: permute the input channels of the stem convs (fnet/cnet
+    conv1) so a model trained on RGB accepts BGR (or vice versa)."""
+    for enc in ("fnet", "cnet"):
+        w = params[enc]["conv1"]["w"]                     # [kH, kW, 3, C]
+        params[enc]["conv1"]["w"] = np.ascontiguousarray(w[:, :, ::-1, :])
+
+
+def from_reference_npz(path_or_dict, strict: bool = True) -> Dict[str, dict]:
+    """Convert a reference-style ``.npz`` (tensorpack variable names, HWIO
+    kernels) to a params pytree (reference weight-load path, SURVEY.md §3.4)."""
+    if isinstance(path_or_dict, (str, bytes)) or hasattr(path_or_dict, "__fspath__"):
+        data = dict(np.load(path_or_dict))
+    else:
+        data = dict(path_or_dict)
+    params: Dict[str, dict] = {}
+    skipped = []
+    for name, arr in data.items():
+        name = name.removesuffix(":0")
+        parts = name.split("/")
+        # leaf may be 'W', 'b', 'gamma', 'beta', 'mean/EMA', 'variance/EMA'
+        if len(parts) >= 2 and parts[-1] == "EMA":
+            leaf_key = "/".join(parts[-2:])
+            parts = parts[:-2]
+        else:
+            leaf_key = parts[-1]
+            parts = parts[:-1]
+        if leaf_key not in _TP_LEAVES:
+            skipped.append(name)
+            continue
+        _set_path(params, parts, _TP_LEAVES[leaf_key], np.asarray(arr))
+    if skipped and strict:
+        raise ValueError(f"unrecognized npz entries: {skipped}")
+    return params
+
+
+def to_state_dict(params: Dict[str, dict], torch_layout: bool = True) -> Dict[str, np.ndarray]:
+    """Flatten a params pytree back to a torch-style state_dict (for export
+    and round-trip testing)."""
+    out: Dict[str, np.ndarray] = {}
+
+    def walk(node, prefix):
+        for k, v in node.items():
+            if isinstance(v, dict):
+                walk(v, prefix + [k])
+            else:
+                arr = np.asarray(v)
+                if k == "w":
+                    name, val = "weight", arr.transpose(3, 2, 0, 1) if torch_layout else arr
+                elif k == "b":
+                    name, val = "bias", arr
+                elif k == "gamma":
+                    name, val = "weight", arr
+                elif k == "beta":
+                    name, val = "bias", arr
+                elif k == "mean":
+                    name, val = "running_mean", arr
+                elif k == "var":
+                    name, val = "running_var", arr
+                else:
+                    raise ValueError(f"unknown leaf {k}")
+                out[".".join(prefix + [name])] = val
+
+    walk(params, [])
+    return out
+
+
+def save_params_npz(params: Dict[str, dict], path) -> None:
+    """Save a params pytree as a flat npz ('/'-joined keys, HWIO layout) —
+    the native raft-tpu single-file checkpoint format."""
+    flat: Dict[str, np.ndarray] = {}
+
+    def walk(node, prefix):
+        for k, v in node.items():
+            if isinstance(v, dict):
+                walk(v, prefix + [k])
+            else:
+                flat["/".join(prefix + [k])] = np.asarray(v)
+
+    walk(params, [])
+    np.savez(path, **flat)
+
+
+def load_params_npz(path) -> Dict[str, dict]:
+    """Inverse of save_params_npz."""
+    params: Dict[str, dict] = {}
+    with np.load(path) as data:
+        for name in data.files:
+            parts = name.split("/")
+            _set_path(params, parts[:-1], parts[-1], data[name])
+    return params
+
+
+def detect_format(path) -> str:
+    """'torch' (.pth/.pt or torch-named npz), 'tensorpack' (reference npz),
+    or 'native' (raft-tpu npz)."""
+    spath = str(path)
+    if spath.endswith((".pth", ".pt")):
+        return "torch"
+    with np.load(spath) as data:
+        names = list(data.files)
+    if any("." in n and "/" not in n for n in names):
+        return "torch"
+    leaves = {n.split("/")[-1] for n in names}
+    if "W" in leaves or "EMA" in leaves:
+        return "tensorpack"
+    return "native"
+
+
+def load_checkpoint_auto(path) -> Dict[str, dict]:
+    """Load any supported checkpoint: torch .pth, reference/tensorpack or
+    native .npz.  Dispatch: .pth -> torch loader; npz with '.'-dotted torch
+    names -> torch map; npz with W/'mean/EMA' leaves -> tensorpack map;
+    npz with w/gamma leaves -> native."""
+    spath = str(path)
+    fmt = detect_format(spath)
+    if fmt == "torch":
+        if spath.endswith((".pth", ".pt")):
+            import torch
+            sd = torch.load(spath, map_location="cpu", weights_only=True)
+            if isinstance(sd, dict) and "model" in sd and isinstance(sd["model"], dict):
+                sd = sd["model"]
+            return from_torch_state_dict(sd)
+        with np.load(spath) as data:
+            return from_torch_state_dict({n: data[n] for n in data.files})
+    if fmt == "tensorpack":
+        return from_reference_npz(spath)
+    return load_params_npz(spath)
+
+
+def assert_tree_shapes_match(converted: Dict[str, dict], expected: Dict[str, dict],
+                             path: str = "") -> None:
+    """Raise with a precise path if structures/shapes differ."""
+    ek = set(expected.keys())
+    ck = set(converted.keys())
+    if ek != ck:
+        raise ValueError(f"at {path or '<root>'}: keys differ; "
+                         f"missing={sorted(ek - ck)} extra={sorted(ck - ek)}")
+    for k in expected:
+        e, c = expected[k], converted[k]
+        if isinstance(e, dict):
+            assert_tree_shapes_match(c, e, f"{path}{k}.")
+        else:
+            if tuple(np.shape(c)) != tuple(np.shape(e)):
+                raise ValueError(f"at {path}{k}: shape {np.shape(c)} != {np.shape(e)}")

@@ -1,0 +1,253 @@
+"""Data augmentation for optical-flow training.
+
+Two augmentors:
+
+* ``PairAugmentor`` — the reference's FlowDataProcess semantics (reference
+  dataflow/test_dataflow.py:13-99): paired photometric transforms with THE
+  SAME parameters applied to both frames (augment_return_params /
+  augment_with_params pattern), random frame-order swap, horizontal flip,
+  random crop, test-mode resize.  Image-pair only (the reference never
+  handled ground-truth flow).
+* ``FlowAugmentor`` — the flow-aware spatial+photometric augmentation a real
+  training run needs (the capability the reference declared but never built):
+  random scale/stretch with flow value rescaling, flips with flow sign flips,
+  random crop, occlusion eraser on frame 2.
+
+All host-side numpy/cv2; runs in the input pipeline, never on device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _apply_contrast(im: np.ndarray, factor: float) -> np.ndarray:
+    mean = im.mean()
+    return np.clip((im - mean) * factor + mean, 0, 255)
+
+
+def _apply_gamma(im: np.ndarray, gamma_exp: float) -> np.ndarray:
+    # tensorpack imgaug.Gamma: lut = (x/255)^(1+gamma) * 255
+    lut = ((np.arange(256) / 255.0) ** (1.0 + gamma_exp) * 255.0)
+    return lut[im.astype(np.uint8).clip(0, 255)].astype(np.float32)
+
+
+def _apply_blur(im: np.ndarray, size: int, sigma: float) -> np.ndarray:
+    if size <= 0:
+        return im
+    import cv2
+    k = 2 * size + 1
+    return cv2.GaussianBlur(im, (k, k), sigma)
+
+
+def _apply_jpeg(im: np.ndarray, quality: int) -> np.ndarray:
+    import cv2
+    ok, enc = cv2.imencode(".jpg", im.astype(np.uint8),
+                           [cv2.IMWRITE_JPEG_QUALITY, int(quality)])
+    assert ok
+    return cv2.imdecode(enc, cv2.IMREAD_COLOR).astype(np.float32)
+
+
+class PairAugmentor:
+    """Reference FlowDataProcess semantics (paired params, no flow)."""
+
+    def __init__(self, input_size: Tuple[int, int],
+                 general_augmentation: bool = False,
+                 rgb_augmentation: bool = False,
+                 random_crop: bool = False, test_mode: bool = False,
+                 rng: Optional[np.random.RandomState] = None):
+        assert len(input_size) == 2
+        self.input_size = tuple(input_size)
+        self.general = general_augmentation
+        self.rgb = rgb_augmentation
+        self.random_crop = random_crop
+        self.test_mode = test_mode
+        self.rng = rng or np.random.RandomState()
+
+    def __call__(self, im1: np.ndarray, im2: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        rng = self.rng
+        im1 = im1.astype(np.float32)
+        im2 = im2.astype(np.float32)
+
+        if self.general and rng.choice([0, 1]) > 0:   # frame-order swap
+            im1, im2 = im2, im1
+
+        if self.rgb:   # same params to both frames (reference :71-73)
+            contrast = rng.uniform(0.8, 1.2)
+            gamma = rng.uniform(-0.3, 0.3)
+            blur_size = rng.randint(0, 3)
+            blur_sigma = rng.uniform(0.2, 0.5)
+            quality = rng.randint(70, 100)
+            for f in ((lambda x: _apply_contrast(x, contrast)),
+                      (lambda x: _apply_gamma(x, gamma)),
+                      (lambda x: _apply_blur(x, blur_size, blur_sigma)),
+                      (lambda x: _apply_jpeg(x, quality))):
+                im1, im2 = f(im1), f(im2)
+
+        if self.general and rng.choice([0, 1]) > 0:   # paired horizontal flip
+            im1, im2 = im1[:, ::-1], im2[:, ::-1]
+
+        h, w = self.input_size
+        if self.random_crop:
+            y0 = rng.randint(0, max(im1.shape[0] - h, 0) + 1)
+            x0 = rng.randint(0, max(im1.shape[1] - w, 0) + 1)
+            im1 = im1[y0:y0 + h, x0:x0 + w]
+            im2 = im2[y0:y0 + h, x0:x0 + w]
+        elif self.test_mode:
+            import cv2
+            im1 = cv2.resize(im1, (w, h))
+            im2 = cv2.resize(im2, (w, h))
+        else:   # eval: top-left crop (reference :91-92)
+            im1 = im1[:h, :w]
+            im2 = im2[:h, :w]
+
+        return im1 / 255.0, im2 / 255.0
+
+
+class SparseFlowAugmentor:
+    """Augmentation for sparse ground truth (KITTI): random crop + horizontal
+    flip only, transforming the validity mask alongside the flow.  No
+    rescaling in round 1 — sparse flow resampling needs valid-aware
+    scattering.  Pads with replicate if a frame is smaller than the crop."""
+
+    accepts_valid = True
+
+    def __init__(self, crop_size: Tuple[int, int], do_flip: bool = True,
+                 rng: Optional[np.random.RandomState] = None):
+        self.crop_size = tuple(crop_size)
+        self.do_flip = do_flip
+        self.rng = rng or np.random.RandomState()
+
+    def __call__(self, im1, im2, flow, valid):
+        rng = self.rng
+        ch, cw = self.crop_size
+        im1 = im1.astype(np.float32)
+        im2 = im2.astype(np.float32)
+        flow = flow.astype(np.float32)
+        valid = valid.astype(np.float32)
+
+        ph = max(ch - im1.shape[0], 0)
+        pw = max(cw - im1.shape[1], 0)
+        if ph or pw:
+            im1 = np.pad(im1, ((0, ph), (0, pw), (0, 0)), mode="edge")
+            im2 = np.pad(im2, ((0, ph), (0, pw), (0, 0)), mode="edge")
+            flow = np.pad(flow, ((0, ph), (0, pw), (0, 0)))
+            valid = np.pad(valid, ((0, ph), (0, pw)))   # padded area invalid
+
+        if self.do_flip and rng.rand() < 0.5:
+            im1 = im1[:, ::-1]
+            im2 = im2[:, ::-1]
+            flow = flow[:, ::-1] * [-1.0, 1.0]
+            valid = valid[:, ::-1]
+
+        y0 = rng.randint(0, im1.shape[0] - ch + 1)
+        x0 = rng.randint(0, im1.shape[1] - cw + 1)
+        sl = np.s_[y0:y0 + ch, x0:x0 + cw]
+        return (np.ascontiguousarray(im1[sl]) / 255.0,
+                np.ascontiguousarray(im2[sl]) / 255.0,
+                np.ascontiguousarray(flow[sl]),
+                np.ascontiguousarray(valid[sl]))
+
+
+class FlowAugmentor:
+    """Flow-aware training augmentation (official-RAFT-style recipe)."""
+
+    def __init__(self, crop_size: Tuple[int, int], min_scale: float = -0.2,
+                 max_scale: float = 0.5, do_flip: bool = True,
+                 spatial_prob: float = 0.8, stretch_prob: float = 0.8,
+                 max_stretch: float = 0.2, eraser_prob: float = 0.5,
+                 photometric: bool = True,
+                 rng: Optional[np.random.RandomState] = None):
+        self.crop_size = tuple(crop_size)
+        self.min_scale = min_scale
+        self.max_scale = max_scale
+        self.do_flip = do_flip
+        self.spatial_prob = spatial_prob
+        self.stretch_prob = stretch_prob
+        self.max_stretch = max_stretch
+        self.eraser_prob = eraser_prob
+        self.photometric = photometric
+        self.rng = rng or np.random.RandomState()
+
+    # -- photometric: paired core + asymmetric jitter
+    def _color(self, im1, im2):
+        rng = self.rng
+        contrast = rng.uniform(0.8, 1.2)
+        gamma = rng.uniform(-0.2, 0.2)
+        brightness = rng.uniform(-20, 20)
+        for f in ((lambda x: _apply_contrast(x, contrast)),
+                  (lambda x: _apply_gamma(x, gamma)),
+                  (lambda x: np.clip(x + brightness, 0, 255))):
+            im1, im2 = f(im1), f(im2)
+        return im1, im2
+
+    def _eraser(self, im2):
+        rng = self.rng
+        if rng.rand() < self.eraser_prob:
+            h, w = im2.shape[:2]
+            mean = im2.reshape(-1, 3).mean(0)
+            for _ in range(rng.randint(1, 3)):
+                x0 = rng.randint(0, w)
+                y0 = rng.randint(0, h)
+                dx = rng.randint(50, 100)
+                dy = rng.randint(50, 100)
+                im2[y0:y0 + dy, x0:x0 + dx] = mean
+        return im2
+
+    def _spatial(self, im1, im2, flow):
+        import cv2
+        rng = self.rng
+        ch, cw = self.crop_size
+        h, w = im1.shape[:2]
+        min_scale = max((ch + 8) / float(h), (cw + 8) / float(w))
+
+        scale = 2.0 ** rng.uniform(self.min_scale, self.max_scale)
+        sx = sy = scale
+        if rng.rand() < self.stretch_prob:
+            sx *= 2.0 ** rng.uniform(-self.max_stretch, self.max_stretch)
+            sy *= 2.0 ** rng.uniform(-self.max_stretch, self.max_stretch)
+        sx = max(sx, min_scale)
+        sy = max(sy, min_scale)
+
+        if rng.rand() < self.spatial_prob:
+            nw, nh = int(round(w * sx)), int(round(h * sy))
+            im1 = cv2.resize(im1, (nw, nh), interpolation=cv2.INTER_LINEAR)
+            im2 = cv2.resize(im2, (nw, nh), interpolation=cv2.INTER_LINEAR)
+            flow = cv2.resize(flow, (nw, nh), interpolation=cv2.INTER_LINEAR)
+            flow = flow * [nw / float(w), nh / float(h)]
+
+        if self.do_flip:
+            if rng.rand() < 0.5:     # horizontal
+                im1 = im1[:, ::-1]
+                im2 = im2[:, ::-1]
+                flow = flow[:, ::-1] * [-1.0, 1.0]
+            if rng.rand() < 0.1:     # vertical
+                im1 = im1[::-1]
+                im2 = im2[::-1]
+                flow = flow[::-1] * [1.0, -1.0]
+
+        y0 = rng.randint(0, im1.shape[0] - ch + 1)
+        x0 = rng.randint(0, im1.shape[1] - cw + 1)
+        im1 = im1[y0:y0 + ch, x0:x0 + cw]
+        im2 = im2[y0:y0 + ch, x0:x0 + cw]
+        flow = flow[y0:y0 + ch, x0:x0 + cw]
+        return im1, im2, flow
+
+    def __call__(self, im1: np.ndarray, im2: np.ndarray, flow: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """uint8 images + [H,W,2] flow -> cropped float [0,1] pair, flow, valid."""
+        im1 = im1.astype(np.float32)
+        im2 = im2.astype(np.float32)
+        flow = flow.astype(np.float32)
+        if self.photometric:
+            im1, im2 = self._color(im1, im2)
+        im1, im2, flow = self._spatial(im1, im2, flow)
+        im2 = self._eraser(np.ascontiguousarray(im2))
+        im1 = np.ascontiguousarray(im1) / 255.0
+        im2 = np.ascontiguousarray(im2) / 255.0
+        flow = np.ascontiguousarray(flow)
+        valid = (np.abs(flow[..., 0]) < 1000) & (np.abs(flow[..., 1]) < 1000)
+        return im1, im2, flow, valid.astype(np.float32)

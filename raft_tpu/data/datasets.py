@@ -1,0 +1,195 @@
+"""Dataset readers: FlyingChairs, FlyingThings3D, MPI-Sintel, KITTI, and the
+reference's bare image-pair list (reference dataflow/test_dataflow.py:101-131).
+
+File-list based: each dataset scans its directory layout once, then serves
+(im1, im2, flow, valid) samples with optional augmentation.  No torch, no
+tensorpack — plain numpy host code feeding the device pipeline.
+"""
+
+from __future__ import annotations
+
+import os.path as osp
+from glob import glob
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.flow_io import read_flo, read_kitti_flow, read_pfm
+from .augment import FlowAugmentor, PairAugmentor
+
+
+def _read_image(path) -> np.ndarray:
+    import cv2
+    im = cv2.imread(str(path), cv2.IMREAD_COLOR)   # BGR, reference convention
+    if im is None:
+        raise FileNotFoundError(path)
+    return im
+
+
+class FlowDataset:
+    """Base: index lists of (im1, im2, flow[, valid]) paths."""
+
+    def __init__(self, augmentor: Optional[FlowAugmentor] = None,
+                 sparse: bool = False):
+        self.augmentor = augmentor
+        self.sparse = sparse
+        self.image_list: List[Tuple[str, str]] = []
+        self.flow_list: List[str] = []
+
+    def __len__(self) -> int:
+        return len(self.image_list)
+
+    def _read_flow(self, idx) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        path = self.flow_list[idx]
+        if self.sparse:
+            flow, valid = read_kitti_flow(path)
+            return flow, valid.astype(np.float32)
+        if str(path).endswith(".pfm"):
+            return read_pfm(path)[:, :, :2], None
+        return read_flo(path), None
+
+    def __getitem__(self, idx):
+        im1 = _read_image(self.image_list[idx][0])
+        im2 = _read_image(self.image_list[idx][1])
+        flow, valid = self._read_flow(idx)
+        if self.augmentor is not None:
+            if valid is not None:
+                if not getattr(self.augmentor, "accepts_valid", False):
+                    raise ValueError("sparse ground truth needs a "
+                                     "SparseFlowAugmentor (got dense FlowAugmentor)")
+                im1, im2, flow, valid = self.augmentor(im1, im2, flow, valid)
+            else:
+                im1, im2, flow, valid = self.augmentor(im1, im2, flow)
+        else:
+            im1 = im1.astype(np.float32) / 255.0
+            im2 = im2.astype(np.float32) / 255.0
+            if valid is None:
+                valid = ((np.abs(flow[..., 0]) < 1000)
+                         & (np.abs(flow[..., 1]) < 1000)).astype(np.float32)
+        return im1, im2, flow.astype(np.float32), valid
+
+    def sample_iter(self, shuffle: bool = True, seed: int = 0,
+                    epochs: Optional[int] = None):
+        rng = np.random.RandomState(seed)
+        epoch = 0
+        while epochs is None or epoch < epochs:
+            order = np.arange(len(self))
+            if shuffle:
+                rng.shuffle(order)
+            for i in order:
+                yield self[int(i)]
+            epoch += 1
+
+
+class MpiSintel(FlowDataset):
+    """root/{training,test}/{clean,final}/<scene>/frame_XXXX.png +
+    root/training/flow/<scene>/frame_XXXX.flo"""
+
+    def __init__(self, root, split: str = "training", dstype: str = "clean",
+                 augmentor: Optional[FlowAugmentor] = None):
+        super().__init__(augmentor)
+        image_root = osp.join(root, split, dstype)
+        flow_root = osp.join(root, split, "flow")
+        for scene in sorted(glob(osp.join(image_root, "*"))):
+            frames = sorted(glob(osp.join(scene, "*.png")))
+            for a, b in zip(frames[:-1], frames[1:]):
+                self.image_list.append((a, b))
+            if split == "training":
+                self.flow_list += sorted(glob(
+                    osp.join(flow_root, osp.basename(scene), "*.flo")))
+        if split == "training":
+            assert len(self.flow_list) == len(self.image_list), (
+                len(self.flow_list), len(self.image_list))
+
+
+class FlyingChairs(FlowDataset):
+    """root/data/xxxxx_img{1,2}.ppm + xxxxx_flow.flo; optional
+    chairs_split.txt (1=train, 2=val)."""
+
+    def __init__(self, root, split: str = "training",
+                 augmentor: Optional[FlowAugmentor] = None):
+        super().__init__(augmentor)
+        images = sorted(glob(osp.join(root, "data", "*.ppm")))
+        flows = sorted(glob(osp.join(root, "data", "*.flo")))
+        assert len(images) // 2 == len(flows), (len(images), len(flows))
+        split_file = osp.join(root, "chairs_split.txt")
+        tags = (np.loadtxt(split_file, dtype=np.int32)
+                if osp.exists(split_file) else np.ones(len(flows), np.int32))
+        want = 1 if split == "training" else 2
+        for i, flow in enumerate(flows):
+            if tags[i] == want:
+                self.image_list.append((images[2 * i], images[2 * i + 1]))
+                self.flow_list.append(flow)
+
+
+class FlyingThings3D(FlowDataset):
+    """root/frames_cleanpass/TRAIN/*/*/{left,right} +
+    root/optical_flow/TRAIN/*/*/into_{future,past}/{left,right}/*.pfm"""
+
+    def __init__(self, root, dstype: str = "frames_cleanpass",
+                 augmentor: Optional[FlowAugmentor] = None):
+        super().__init__(augmentor)
+        idirs = sorted(glob(osp.join(root, dstype, "TRAIN/*/*")))
+        fdirs = sorted(glob(osp.join(root, "optical_flow/TRAIN/*/*")))
+        for cam in ("left",):
+            for direction in ("into_future", "into_past"):
+                for idir, fdir in zip(idirs, fdirs):
+                    images = sorted(glob(osp.join(idir, cam, "*.png")))
+                    flows = sorted(glob(osp.join(fdir, direction, cam, "*.pfm")))
+                    if direction == "into_future":
+                        pairs = zip(images[:-1], images[1:], flows[:-1])
+                    else:
+                        pairs = zip(images[1:], images[:-1], flows[1:])
+                    for a, b, f in pairs:
+                        self.image_list.append((a, b))
+                        self.flow_list.append(f)
+
+
+class Kitti(FlowDataset):
+    """root/{training,testing}/image_2 pairs + flow_occ 16-bit PNGs."""
+
+    def __init__(self, root, split: str = "training",
+                 augmentor: Optional[FlowAugmentor] = None):
+        super().__init__(augmentor, sparse=True)
+        images1 = sorted(glob(osp.join(root, split, "image_2", "*_10.png")))
+        images2 = sorted(glob(osp.join(root, split, "image_2", "*_11.png")))
+        self.image_list = list(zip(images1, images2))
+        if split == "training":
+            self.flow_list = sorted(glob(osp.join(root, split, "flow_occ", "*_10.png")))
+
+
+class PairList:
+    """The reference's Testset: a plain list of image pairs, no flow
+    (reference dataflow/test_dataflow.py:101-131)."""
+
+    def __init__(self, filelist: Sequence[Tuple[str, str]],
+                 input_size: Tuple[int, int],
+                 augmentor: Optional[PairAugmentor] = None):
+        self.filelist = list(filelist)
+        self.processor = augmentor or PairAugmentor(input_size, test_mode=True)
+
+    def __len__(self):
+        return len(self.filelist)
+
+    def __iter__(self):
+        for a, b in self.filelist:
+            yield self.processor(_read_image(a), _read_image(b))
+
+
+def make_training_dataset(stage: str, root: str,
+                          crop_size: Tuple[int, int]) -> FlowDataset:
+    """Stage presets following the official curriculum: chairs -> things ->
+    sintel/kitti finetune."""
+    if stage == "chairs":
+        aug = FlowAugmentor(crop_size, min_scale=-0.1, max_scale=1.0)
+        return FlyingChairs(root, "training", aug)
+    if stage == "things":
+        aug = FlowAugmentor(crop_size, min_scale=-0.4, max_scale=0.8)
+        return FlyingThings3D(root, augmentor=aug)
+    if stage == "sintel":
+        aug = FlowAugmentor(crop_size, min_scale=-0.2, max_scale=0.6)
+        return MpiSintel(root, "training", "clean", aug)
+    if stage == "kitti":
+        from .augment import SparseFlowAugmentor
+        return Kitti(root, "training", augmentor=SparseFlowAugmentor(crop_size))
+    raise ValueError(stage)

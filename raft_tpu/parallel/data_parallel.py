@@ -1,0 +1,64 @@
+"""Data-parallel training / evaluation via shard_map over the device mesh.
+
+Batch is sharded over the 'data' axis; parameters and optimizer state are
+replicated; gradients and metrics are pmean'd over ICI inside the step (see
+training/step.py: the same step function, given an axis_name, also
+synchronizes batch-norm statistics cross-replica).  This is the TPU-native
+equivalent of the reference's implied-but-dead multi-GPU trainer stack
+(reference infer_raft.py:13, SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import RAFTConfig, TrainConfig
+from ..training.step import Batch, make_eval_step, make_train_step
+from .mesh import DATA_AXIS
+
+
+def make_dp_train_step(config: RAFTConfig, tconfig: TrainConfig, tx,
+                       mesh: Mesh, axis: str = DATA_AXIS):
+    """Returns jitted (state, batch, rng) -> (state, metrics) with the batch
+    sharded over ``axis`` and state replicated."""
+    inner = make_train_step(config, tconfig, tx, axis_name=axis)
+    batch_spec = Batch(P(axis), P(axis), P(axis), P(axis))
+    f = jax.shard_map(inner, mesh=mesh,
+                      in_specs=(P(), batch_spec, P()),
+                      out_specs=(P(), P()),
+                      check_vma=False)
+    return jax.jit(f)
+
+
+def make_pjit_train_step(config: RAFTConfig, tconfig: TrainConfig, tx,
+                         mesh: Mesh, data_axis: str = DATA_AXIS,
+                         spatial_axis: Optional[str] = None):
+    """Train step via jit sharding annotations (the pjit path): batch sharded
+    over ``data_axis`` on B and optionally ``spatial_axis`` on H; params and
+    optimizer state replicated.  XLA's SPMD partitioner inserts the gradient
+    all-reduce, the conv halo exchanges, and the correlation collectives.
+    Complements the explicit shard_map path (make_dp_train_step)."""
+    from jax.sharding import NamedSharding
+
+    inner = make_train_step(config, tconfig, tx, axis_name=None)
+    img = NamedSharding(mesh, P(data_axis, spatial_axis))
+    planar = NamedSharding(mesh, P(data_axis, spatial_axis))
+    rep = NamedSharding(mesh, P())
+    batch_shardings = Batch(img, img, planar, planar)
+    return jax.jit(inner,
+                   in_shardings=(rep, batch_shardings, rep),
+                   out_shardings=(rep, rep))
+
+
+def make_dp_eval_fn(config: RAFTConfig, mesh: Mesh,
+                    iters: Optional[int] = None, axis: str = DATA_AXIS):
+    """Returns jitted (params, im1, im2) -> flow, batch sharded over ``axis``."""
+    inner = make_eval_step(config, iters=iters)
+    f = jax.shard_map(inner, mesh=mesh,
+                      in_specs=(P(), P(axis), P(axis)),
+                      out_specs=P(axis),
+                      check_vma=False)
+    return jax.jit(f)

@@ -1,0 +1,56 @@
+"""Multi-host (multi-process) coordination over DCN.
+
+The TPU-native replacement for the reference's absent comm backend
+(SURVEY.md §2.3): ``jax.distributed.initialize`` for process coordination,
+a global mesh spanning all hosts' devices, and per-host batch slicing so
+each process feeds only its local shard (host data loading over DCN, compute
+collectives over ICI).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Initialize multi-host JAX.  No-ops cleanly for single-process runs
+    (and under test environments without a coordinator)."""
+    if num_processes is None:
+        num_processes = int(os.environ.get("RAFT_TPU_NUM_PROCESSES", "1"))
+    if num_processes <= 1:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def process_info() -> Tuple[int, int]:
+    return jax.process_index(), jax.process_count()
+
+
+def local_batch_slice(global_batch: int) -> slice:
+    """Each process loads only its slice of the global batch."""
+    pid, pcount = process_info()
+    assert global_batch % pcount == 0, (global_batch, pcount)
+    per = global_batch // pcount
+    return slice(pid * per, (pid + 1) * per)
+
+
+def global_mesh(axes=("data",), shape=None) -> "jax.sharding.Mesh":
+    """Mesh over ALL devices across hosts (jax.devices() is global)."""
+    from .mesh import make_mesh
+    return make_mesh(axes=axes, shape=shape)
+
+
+def assemble_global_array(local_np, mesh, spec):
+    """Build a jax.Array for a globally-sharded batch from per-host data
+    (jax.make_array_from_process_local_data)."""
+    from jax.sharding import NamedSharding
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_process_local_data(sharding, local_np)

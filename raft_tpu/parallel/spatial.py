@@ -1,0 +1,99 @@
+"""Spatial (sequence/context-parallel analog) sharding.
+
+The reference's (HW)^2 correlation volume is structurally long-context
+attention (SURVEY.md §5): Q = fmap1 rows, K = fmap2, memory O((HW)^2).  For
+high resolutions the TPU answer is to shard the *query* rows across devices:
+each device computes correlation and windowed lookup for its row-block of
+queries against the (all-gathered) fmap2 — distributed blockwise correlation,
+collectives riding ICI.  Plus a halo-exchange primitive so convolutions can
+run on row-sharded activations inside shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import RAFTConfig
+from ..ops.corr import build_pyramid, lookup_dense
+from .mesh import SPATIAL_AXIS
+
+
+def halo_exchange(x: jax.Array, halo: int, axis_name: str = SPATIAL_AXIS) -> jax.Array:
+    """Pad the H axis (axis 1 of [B, H, W, C]) of a row-sharded block with
+    ``halo`` rows from the neighboring shards (zeros at the outer edges, i.e.
+    the image boundary — matching torch zero padding).
+
+    Returns [B, H + 2*halo, W, C]."""
+    if halo == 0:
+        return x
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    top = x[:, :halo]          # my top rows -> previous device's bottom halo
+    bot = x[:, -halo:]         # my bottom rows -> next device's top halo
+    # from next device: its top rows become my bottom halo
+    from_next = jax.lax.ppermute(top, axis_name,
+                                 [(i, (i - 1) % n) for i in range(n)])
+    # from previous device: its bottom rows become my top halo
+    from_prev = jax.lax.ppermute(bot, axis_name,
+                                 [(i, (i + 1) % n) for i in range(n)])
+    zeros = jnp.zeros_like(top)
+    top_halo = jnp.where(idx == 0, zeros, from_prev)
+    bot_halo = jnp.where(idx == n - 1, zeros, from_next)
+    return jnp.concatenate([top_halo, x, bot_halo], axis=1)
+
+
+def conv2d_row_sharded(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None,
+                       stride: int = 1, axis_name: str = SPATIAL_AXIS) -> jax.Array:
+    """conv2d on row-sharded activations: halo-exchange in H, torch-symmetric
+    padding in W, VALID in H after the halo."""
+    kh, kw = w.shape[0], w.shape[1]
+    x = halo_exchange(x, kh // 2, axis_name)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=((0, 0), (kw // 2, kw // 2)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if b is not None:
+        out = out + b
+    return out
+
+
+def make_spatial_corr_lookup(mesh: Mesh, num_levels: int, radius: int,
+                             axis: str = SPATIAL_AXIS):
+    """Distributed blockwise correlation: fmap1/coords row-sharded over
+    ``axis``, fmap2 row-sharded then all-gathered level-wise inside.
+
+    Returns jitted (fmap1, fmap2, coords) -> corr features, output sharded
+    like the queries.  Device memory: O(HW/n * HW) instead of O((HW)^2)."""
+
+    def inner(f1_local, f2_local, coords_local):
+        f2_full = jax.lax.all_gather(f2_local, axis, axis=1, tiled=True)
+        pyramid = build_pyramid(f1_local, f2_full, num_levels)
+        return lookup_dense(pyramid, coords_local, radius)
+
+    f = jax.shard_map(inner, mesh=mesh,
+                      in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+                      out_specs=P(None, axis),
+                      check_vma=False)
+    return jax.jit(f)
+
+
+def make_spatial_inference_fn(config: RAFTConfig, mesh: Mesh,
+                              iters: Optional[int] = None,
+                              axis: str = SPATIAL_AXIS):
+    """Whole-model inference with images row-sharded over ``axis`` via jit
+    sharding annotations: XLA's SPMD partitioner inserts the halo exchanges
+    for the convolutions and the collectives for the correlation
+    automatically — the pjit path, complementing the explicit shard_map path
+    above."""
+    from ..models.raft import make_inference_fn
+
+    fn = make_inference_fn(config, iters=iters)
+    img_sharding = NamedSharding(mesh, P(None, axis))
+    rep = NamedSharding(mesh, P())
+
+    return jax.jit(fn, in_shardings=(rep, img_sharding, img_sharding),
+                   out_shardings=img_sharding)

@@ -1,0 +1,5 @@
+from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
+from .loss import epe_metrics, sequence_loss
+from .optim import make_optimizer, make_schedule, one_cycle_schedule
+from .state import TrainState, merge_bn_state, split_bn_state
+from .step import Batch, make_eval_step, make_train_step

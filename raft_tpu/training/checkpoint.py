@@ -1,0 +1,73 @@
+"""Checkpoint save / resume for full training state.
+
+The reference could only LOAD converted weights at session init — no saving,
+no optimizer state, no resume (SURVEY.md §5 'Checkpoint / resume').  Here the
+whole TrainState (step, trainable params, BN stats, optimizer state) is
+serialized; restore takes a template state (created fresh from the same
+configs) so arbitrary optax pytrees round-trip exactly.  Single-file npz —
+multi-host safe (only process 0 writes; everyone restores identically).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+def save_checkpoint(path, state, overwrite: bool = True) -> None:
+    """Serialize any pytree of arrays/scalars to a single npz."""
+    path = Path(path)
+    if path.exists() and not overwrite:
+        raise FileExistsError(path)
+    leaves = jax.tree.leaves(state)
+    arrays = {f"leaf_{i:05d}": np.asarray(x) for i, x in enumerate(leaves)}
+    path.parent.mkdir(parents=True, exist_ok=True)
+    # write-then-rename so a crash never leaves a torn checkpoint
+    fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".tmp.npz")
+    os.close(fd)
+    try:
+        np.savez(tmp, **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def restore_checkpoint(path, template):
+    """Restore into the structure of ``template`` (a freshly-created state)."""
+    leaves, treedef = jax.tree.flatten(template)
+    with np.load(path) as data:
+        names = sorted(data.files)
+        if len(names) != len(leaves):
+            raise ValueError(
+                f"checkpoint {path} has {len(names)} leaves, template has "
+                f"{len(leaves)} — configs differ from the saved run")
+        restored = []
+        for name, leaf in zip(names, leaves):
+            arr = data[name]
+            want = np.shape(leaf)
+            if tuple(arr.shape) != tuple(want):
+                raise ValueError(f"{path}: leaf {name} shape {arr.shape} != "
+                                 f"template {want}")
+            restored.append(jax.numpy.asarray(arr) if hasattr(leaf, "dtype")
+                            else arr.item() if arr.ndim == 0 else arr)
+    return jax.tree.unflatten(treedef, restored)
+
+
+def latest_checkpoint(ckpt_dir) -> Optional[Path]:
+    """Newest step-numbered checkpoint in a directory (ckpt_<step>.npz)."""
+    ckpt_dir = Path(ckpt_dir)
+    if not ckpt_dir.is_dir():
+        return None
+    best, best_step = None, -1
+    for p in ckpt_dir.glob("ckpt_*.npz"):
+        m = re.fullmatch(r"ckpt_(\d+)\.npz", p.name)
+        if m and int(m.group(1)) > best_step:
+            best, best_step = p, int(m.group(1))
+    return best

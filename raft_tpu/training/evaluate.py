@@ -1,0 +1,75 @@
+"""EPE evaluation harness (Sintel / KITTI / Chairs).
+
+Creates the quantitative baseline the reference never had (SURVEY.md §6: 'no
+EPE evaluation code exists').  Pads inputs to /8 (replicate, split padding),
+runs the jitted model at full resolution, unpads, aggregates EPE / pixel-rate
+/ Fl-all statistics.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import RAFTConfig
+from ..data.pipeline import pad_to_multiple, unpad
+from .loss import epe_metrics
+from .step import make_eval_step
+
+
+def evaluate_dataset(params, config: RAFTConfig, dataset,
+                     iters: Optional[int] = None, max_samples: Optional[int] = None,
+                     pad_mode: str = "sintel", verbose: bool = True) -> Dict[str, float]:
+    """dataset yields (im1, im2, flow_gt, valid) numpy samples (augmentor=None)."""
+    eval_fn = jax.jit(make_eval_step(config, iters=iters))
+    sums: Dict[str, float] = {}
+    count = 0
+    t0 = time.time()
+    n = len(dataset) if max_samples is None else min(max_samples, len(dataset))
+    for idx in range(n):
+        im1, im2, flow_gt, valid = dataset[idx]
+        im1p, pads = pad_to_multiple(im1[None], 8, pad_mode)
+        im2p, _ = pad_to_multiple(im2[None], 8, pad_mode)
+        flow = np.asarray(eval_fn(params, jnp.asarray(im1p), jnp.asarray(im2p)))
+        flow = unpad(flow, pads)[0]
+        m = jax.device_get(epe_metrics(jnp.asarray(flow), jnp.asarray(flow_gt),
+                                       jnp.asarray(valid)))
+        for k, v in m.items():
+            sums[k] = sums.get(k, 0.0) + float(v)
+        count += 1
+        if verbose and (idx + 1) % 50 == 0:
+            print(f"  eval {idx + 1}/{n}  epe so far {sums['epe'] / count:.3f}")
+    out = {k: v / max(count, 1) for k, v in sums.items()}
+    out["samples"] = count
+    out["seconds"] = time.time() - t0
+    return out
+
+
+def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
+    from ..data import datasets as D
+    params = load_params(args, config)
+    if args.data is None:
+        print("ERROR: --data <dataset root> is required for val mode")
+        return 2
+    if args.dataset == "sintel":
+        ds = D.MpiSintel(args.data, "training", "clean")
+        pad_mode = "sintel"
+    elif args.dataset == "chairs":
+        ds = D.FlyingChairs(args.data, "validation")
+        pad_mode = "sintel"
+    elif args.dataset == "things":
+        ds = D.FlyingThings3D(args.data)
+        pad_mode = "sintel"
+    else:
+        ds = D.Kitti(args.data, "training")
+        pad_mode = "kitti"
+    metrics = evaluate_dataset(params, config, ds, iters=args.iters,
+                               pad_mode=pad_mode)
+    name = f"{args.dataset} ({'small' if args.small else 'full'})"
+    print(f"[val] {name}: " + "  ".join(
+        f"{k}={v:.4f}" for k, v in metrics.items()))
+    return 0

@@ -1,0 +1,113 @@
+"""Training loop: the subsystem the reference declared but never built
+(reference readme.md:14 'TODO: Training'; SURVEY.md §3.6).
+
+Single-host loop driving the jitted train step; data-parallel over all local
+devices via parallel.data_parallel when more than one is present; checkpoint
+save/resume; scalar logging.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import RAFTConfig, TrainConfig
+from ..models import init_raft
+from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
+from .optim import make_optimizer
+from .state import TrainState
+from .step import Batch, make_train_step
+
+
+def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
+          ckpt_dir: Optional[str] = None, resume: bool = True,
+          data_parallel: bool = True, log_fn=print) -> TrainState:
+    """Run the training loop over ``batch_iter`` yielding numpy
+    (im1, im2, flow, valid) batches; returns the final state."""
+    tx = make_optimizer(tconfig)
+    key = jax.random.PRNGKey(tconfig.seed)
+    params = init_raft(key, config)
+    state = TrainState.create(params, tx)
+
+    n_dev = len(jax.devices())
+    if data_parallel and n_dev > 1 and tconfig.batch_size % n_dev != 0:
+        log_fn(f"[train] batch {tconfig.batch_size} not divisible by "
+               f"{n_dev} devices; falling back to single-device")
+        data_parallel = False
+    if data_parallel and n_dev > 1:
+        from ..parallel.data_parallel import make_dp_train_step
+        from ..parallel.mesh import make_mesh
+        mesh = make_mesh()
+        step_fn = make_dp_train_step(config, tconfig, tx, mesh)
+        log_fn(f"[train] data-parallel over {n_dev} devices")
+    else:
+        step_fn = jax.jit(make_train_step(config, tconfig, tx))
+
+    start_step = 0
+    if ckpt_dir and resume:
+        latest = latest_checkpoint(ckpt_dir)
+        if latest is not None:
+            state = restore_checkpoint(latest, state)
+            start_step = int(state.step)
+            log_fn(f"[train] resumed from {latest} at step {start_step}")
+
+    rng = jax.random.PRNGKey(tconfig.seed + 1)
+    t0 = time.time()
+    seen = 0
+    for batch_np in batch_iter:
+        step = int(state.step)
+        if step >= tconfig.num_steps:
+            break
+        rng, sub = jax.random.split(rng)
+        batch = Batch(*jax.tree.map(jnp.asarray, tuple(batch_np)))
+        state, metrics = step_fn(state, batch, sub)
+        seen += 1
+        if step % tconfig.log_every == 0 or step + 1 >= tconfig.num_steps:
+            m = jax.device_get(metrics)
+            rate = seen / max(time.time() - t0, 1e-9)
+            log_fn(f"[train] step {step}  loss {float(m['loss']):.4f}  "
+                   f"epe {float(m['epe']):.3f}  1px {float(m['1px']):.3f}  "
+                   f"gnorm {float(m['grad_norm']):.2f}  {rate:.2f} it/s")
+        if ckpt_dir and (step + 1) % tconfig.ckpt_every == 0:
+            p = Path(ckpt_dir) / f"ckpt_{step + 1}.npz"
+            save_checkpoint(p, jax.device_get(state))
+            log_fn(f"[train] saved {p}")
+
+    if ckpt_dir:
+        p = Path(ckpt_dir) / f"ckpt_{int(state.step)}.npz"
+        save_checkpoint(p, jax.device_get(state))
+        log_fn(f"[train] saved final {p}")
+    return state
+
+
+def train_cli(args, config: RAFTConfig) -> int:
+    from ..data.pipeline import PrefetchLoader, batched, synthetic_batches
+
+    overrides = {}
+    if args.num_steps is not None:
+        overrides["num_steps"] = args.num_steps
+    if args.lr is not None:
+        overrides["lr"] = args.lr
+    overrides["optimizer"] = args.optimizer
+    overrides["batch_size"] = args.batch
+    tconfig = TrainConfig(**overrides)
+
+    if args.data:
+        from ..data.datasets import make_training_dataset
+        ds = make_training_dataset(args.dataset, args.data, tconfig.image_size)
+        print(f"[train] {args.dataset}: {len(ds)} samples")
+        batch_iter = PrefetchLoader(
+            batched(ds.sample_iter(seed=tconfig.seed), tconfig.batch_size))
+    else:
+        print("[train] no --data: running on SYNTHETIC batches (smoke mode)")
+        size = (64, 96)
+        batch_iter = PrefetchLoader(synthetic_batches(tconfig.batch_size, size))
+
+    ckpt_dir = str(Path(args.out) / tconfig.ckpt_dir)
+    train(config, tconfig, batch_iter, ckpt_dir=ckpt_dir)
+    return 0

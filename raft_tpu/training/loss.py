@@ -1,0 +1,66 @@
+"""Sequence loss and flow metrics.
+
+The reference has NO loss — build_graph returns a literal 0.0 (reference
+RAFT.py:141, SURVEY.md §3.6).  This implements the RAFT paper's recipe: the
+gamma-weighted L1 over every iteration's upsampled flow prediction, with
+ground-truth flows beyond ``max_flow`` masked out.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array,
+                  valid: Optional[jax.Array] = None, gamma: float = 0.8,
+                  max_flow: float = 400.0) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """L_seq = sum_i gamma^(N-i-1) * mean_valid |pred_i - gt|_1.
+
+    flow_preds: [iters, B, H, W, 2] upsampled per-iteration predictions.
+    flow_gt: [B, H, W, 2]; valid: [B, H, W] bool/0-1 mask (None = all valid).
+    Returns (scalar loss, metrics dict with epe / 1px / 3px / 5px on the
+    final prediction).
+    """
+    n = flow_preds.shape[0]
+    mag = jnp.linalg.norm(flow_gt, axis=-1)
+    v = jnp.ones_like(mag) if valid is None else valid.astype(jnp.float32)
+    v = v * (mag < max_flow)
+    denom = jnp.maximum(v.sum(), 1.0)
+
+    weights = gamma ** jnp.arange(n - 1, -1, -1, dtype=jnp.float32)  # [n]
+    l1 = jnp.abs(flow_preds - flow_gt[None]).mean(axis=-1)           # [n,B,H,W]
+    per_iter = (l1 * v[None]).sum(axis=(1, 2, 3)) / denom            # [n]
+    loss = (weights * per_iter).sum()
+
+    epe = jnp.linalg.norm(flow_preds[-1] - flow_gt, axis=-1)         # [B,H,W]
+    epe_valid = epe * v
+    metrics = {
+        "loss": loss,
+        "epe": epe_valid.sum() / denom,
+        "1px": ((epe < 1.0) * v).sum() / denom,
+        "3px": ((epe < 3.0) * v).sum() / denom,
+        "5px": ((epe < 5.0) * v).sum() / denom,
+    }
+    return loss, metrics
+
+
+def epe_metrics(flow_pred: jax.Array, flow_gt: jax.Array,
+                valid: Optional[jax.Array] = None) -> Dict[str, jax.Array]:
+    """End-point-error statistics for evaluation (the measurement harness the
+    reference never had, SURVEY.md §6)."""
+    epe = jnp.linalg.norm(flow_pred - flow_gt, axis=-1)
+    v = jnp.ones_like(epe) if valid is None else valid.astype(jnp.float32)
+    denom = jnp.maximum(v.sum(), 1.0)
+    mag = jnp.maximum(jnp.linalg.norm(flow_gt, axis=-1), 1e-6)
+    # KITTI Fl-all: error > 3px AND > 5% of magnitude
+    fl = ((epe > 3.0) & (epe / mag > 0.05)).astype(jnp.float32)
+    return {
+        "epe": (epe * v).sum() / denom,
+        "1px": ((epe < 1.0) * v).sum() / denom,
+        "3px": ((epe < 3.0) * v).sum() / denom,
+        "5px": ((epe < 5.0) * v).sum() / denom,
+        "fl_all": (fl * v).sum() / denom,
+    }

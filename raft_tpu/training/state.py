@@ -1,0 +1,76 @@
+"""Train state: trainable parameters vs batch-norm running statistics.
+
+BN running stats live inside the params pytree (leaf names 'mean' / 'var').
+They receive no gradient in train mode and must not be weight-decayed or
+Adam-updated; they are refreshed from the forward pass instead.  This module
+splits/merges them so optax only ever sees trainable leaves.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import numpy as np
+import optax
+
+_STATE_LEAVES = ("mean", "var")
+
+
+def split_bn_state(params: Dict[str, Any]) -> Tuple[dict, dict]:
+    """params -> (trainable, bn_state); bn_state keeps only mean/var leaves
+    (same nesting, missing elsewhere)."""
+    trainable: dict = {}
+    state: dict = {}
+
+    def walk(node, t, s):
+        for k, v in node.items():
+            if isinstance(v, dict):
+                t[k], s[k] = {}, {}
+                walk(v, t[k], s[k])
+                if not s[k]:
+                    del s[k]
+            elif k in _STATE_LEAVES:
+                s[k] = v
+            else:
+                t[k] = v
+
+    walk(params, trainable, state)
+    return trainable, state
+
+
+def merge_bn_state(trainable: dict, bn_state: dict) -> dict:
+    """Inverse of split_bn_state."""
+    merged: dict = {}
+
+    def walk(t, s, out):
+        for k, v in t.items():
+            if isinstance(v, dict):
+                out[k] = {}
+                walk(v, s.get(k, {}) if s else {}, out[k])
+            else:
+                out[k] = v
+        if s:
+            for k, v in s.items():
+                if not isinstance(v, dict):
+                    out[k] = v
+
+    walk(trainable, bn_state, merged)
+    return merged
+
+
+class TrainState(NamedTuple):
+    step: jax.Array                  # scalar int32
+    params: dict                     # trainable leaves only
+    bn_state: dict                   # BN running stats
+    opt_state: optax.OptState
+
+    @staticmethod
+    def create(full_params: dict, tx: optax.GradientTransformation) -> "TrainState":
+        trainable, bn = split_bn_state(full_params)
+        return TrainState(step=jax.numpy.zeros((), jax.numpy.int32),
+                          params=trainable, bn_state=bn,
+                          opt_state=tx.init(trainable))
+
+    def full_params(self) -> dict:
+        return merge_bn_state(self.params, self.bn_state)

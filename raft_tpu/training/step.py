@@ -1,0 +1,67 @@
+"""Jittable train / eval steps (single-device and SPMD via axis_name).
+
+The single-device step is the building block; parallel/data_parallel.py wraps
+it in shard_map over the device mesh with psum'd gradients — the TPU-native
+replacement for the reference's dead tensorpack parameter-server trainer
+import (reference infer_raft.py:13, SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..config import RAFTConfig, TrainConfig
+from ..models.raft import raft_forward
+from .loss import sequence_loss
+from .state import TrainState, merge_bn_state, split_bn_state
+
+
+class Batch(NamedTuple):
+    image1: jax.Array           # [B, H, W, 3] float in [0, 1]
+    image2: jax.Array
+    flow: jax.Array             # [B, H, W, 2]
+    valid: jax.Array            # [B, H, W] float/bool
+
+
+def make_train_step(config: RAFTConfig, tconfig: TrainConfig,
+                    tx: optax.GradientTransformation,
+                    axis_name: Optional[str] = None):
+    """Returns step(state, batch, rng) -> (new_state, metrics)."""
+
+    def train_step(state: TrainState, batch: Batch, rng: jax.Array):
+        def loss_fn(trainable):
+            params = merge_bn_state(trainable, state.bn_state)
+            out, new_params = raft_forward(
+                params, batch.image1, batch.image2, config, train=True,
+                axis_name=axis_name, rng=rng)
+            loss, metrics = sequence_loss(out.flow_iters, batch.flow,
+                                          batch.valid, gamma=tconfig.gamma,
+                                          max_flow=tconfig.max_flow)
+            _, new_bn = split_bn_state(new_params)
+            return loss, (new_bn, metrics)
+
+        grads, (new_bn, metrics) = jax.grad(loss_fn, has_aux=True)(state.params)
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+            metrics = jax.lax.pmean(metrics, axis_name)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_trainable = optax.apply_updates(state.params, updates)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return TrainState(state.step + 1, new_trainable, new_bn, new_opt), metrics
+
+    return train_step
+
+
+def make_eval_step(config: RAFTConfig, iters: Optional[int] = None):
+    """Returns step(params, image1, image2) -> final full-res flow."""
+
+    def eval_step(params, image1, image2):
+        out, _ = raft_forward(params, image1, image2, config, iters=iters,
+                              train=False, all_flows=False)
+        return out.flow
+
+    return eval_step

@@ -1,0 +1,102 @@
+"""Flow-field file I/O: Middlebury .flo, PFM (FlyingThings3D), KITTI 16-bit
+PNG, plus flow resizing.  Covers reference flow_utils.py:277-318 and extends
+it with the formats the training datasets need (the reference had no
+training, SURVEY.md §3.6).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+import numpy as np
+
+_FLO_MAGIC = 202021.25  # 'PIEH' interpreted as float
+
+
+def read_flo(path) -> np.ndarray:
+    """Read a Middlebury .flo file -> [H, W, 2] float32."""
+    with open(path, "rb") as f:
+        magic = np.fromfile(f, np.float32, 1)[0]
+        if magic != _FLO_MAGIC:
+            raise ValueError(f"{path}: bad .flo magic {magic}")
+        w = int(np.fromfile(f, np.int32, 1)[0])
+        h = int(np.fromfile(f, np.int32, 1)[0])
+        data = np.fromfile(f, np.float32, h * w * 2)
+    return data.reshape(h, w, 2)
+
+
+def write_flo(flow: np.ndarray, path) -> None:
+    """Write [H, W, 2] flow as .flo."""
+    assert flow.ndim == 3 and flow.shape[2] == 2, flow.shape
+    with open(path, "wb") as f:
+        np.float32(_FLO_MAGIC).tofile(f)
+        np.array([flow.shape[1], flow.shape[0]], np.int32).tofile(f)
+        flow.astype(np.float32).tofile(f)
+
+
+# readFlow/writeFlow aliases matching the reference API surface
+readFlow = read_flo
+writeFlow = write_flo
+
+
+def read_pfm(path) -> np.ndarray:
+    """Read a PFM file (FlyingThings3D disparity/flow) -> float32 array."""
+    with open(path, "rb") as f:
+        header = f.readline().rstrip()
+        color = header == b"PF"
+        if header not in (b"PF", b"Pf"):
+            raise ValueError(f"{path}: not a PFM file")
+        dims = re.match(rb"^(\d+)\s(\d+)\s$", f.readline())
+        if not dims:
+            raise ValueError(f"{path}: malformed PFM header")
+        w, h = map(int, dims.groups())
+        scale = float(f.readline().rstrip())
+        endian = "<" if scale < 0 else ">"
+        data = np.fromfile(f, endian + "f")
+    shape = (h, w, 3) if color else (h, w)
+    return np.flipud(data.reshape(shape)).astype(np.float32)
+
+
+def read_kitti_flow(path) -> tuple[np.ndarray, np.ndarray]:
+    """KITTI 16-bit PNG flow -> ([H, W, 2] flow, [H, W] valid mask)."""
+    import cv2
+    raw = cv2.imread(str(path), cv2.IMREAD_ANYDEPTH | cv2.IMREAD_COLOR)
+    if raw is None:
+        raise FileNotFoundError(path)
+    raw = raw[:, :, ::-1].astype(np.float32)   # BGR -> RGB = (u, v, valid)
+    flow = (raw[:, :, :2] - 2 ** 15) / 64.0
+    valid = raw[:, :, 2] > 0.5
+    return flow, valid
+
+
+def write_kitti_flow(flow: np.ndarray, path, valid: np.ndarray | None = None) -> None:
+    import cv2
+    h, w = flow.shape[:2]
+    out = np.ones((h, w, 3), np.uint16)
+    if valid is not None:
+        out[:, :, 2] = valid.astype(np.uint16)
+    out[:, :, :2] = np.clip(flow * 64.0 + 2 ** 15, 0, 2 ** 16 - 1).astype(np.uint16)
+    cv2.imwrite(str(path), out[:, :, ::-1])
+
+
+def read_flow_any(path) -> np.ndarray:
+    """Dispatch by extension (.flo / .pfm / .png)."""
+    suffix = Path(path).suffix.lower()
+    if suffix == ".flo":
+        return read_flo(path)
+    if suffix == ".pfm":
+        return read_pfm(path)[:, :, :2]
+    if suffix == ".png":
+        return read_kitti_flow(path)[0]
+    raise ValueError(f"unknown flow format: {path}")
+
+
+def resize_flow(flow: np.ndarray, new_w: int, new_h: int) -> np.ndarray:
+    """Resize [H, W, 2] flow, rescaling u, v by the size ratio
+    (reference flow_utils.py:277-284)."""
+    import cv2
+    h, w = flow.shape[:2]
+    u = cv2.resize(flow[:, :, 0], (new_w, new_h)) * (new_w / float(w))
+    v = cv2.resize(flow[:, :, 1], (new_w, new_h)) * (new_h / float(h))
+    return np.dstack((u, v))

@@ -1,0 +1,57 @@
+"""Profiling: parameter tables and FLOP counting.
+
+TPU-native replacement for the reference's flops mode (reference
+infer_raft.py:80-95: tensorpack describe_trainable_vars + tf.profiler —
+which crashed on an arity bug before ever printing, SURVEY.md §3.3).
+Here: pytree param census + XLA ``cost_analysis`` on the compiled forward.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import numpy as np
+
+
+def param_table(params, prefix: str = "") -> str:
+    """Human-readable table of every leaf: path, shape, #params."""
+    rows = []
+    total = 0
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        total += n
+        rows.append((prefix + name, str(tuple(leaf.shape)), n))
+    width = max((len(r[0]) for r in rows), default=10) + 2
+    lines = [f"{'name':<{width}}{'shape':<20}{'#':>12}"]
+    lines += [f"{n:<{width}}{s:<20}{c:>12,}" for n, s, c in rows]
+    lines.append(f"{'TOTAL':<{width}}{'':<20}{total:>12,}")
+    return "\n".join(lines)
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+
+
+def cost_analysis(fn: Callable, *args) -> Dict[str, float]:
+    """XLA cost analysis of the jitted ``fn(*args)``: flops, bytes accessed.
+
+    Note XLA counts a multiply-add as 2 flops (same caveat the reference
+    logged about tf.profiler, infer_raft.py:93-95).
+    """
+    lowered = jax.jit(fn).lower(*args)
+    compiled = lowered.compile()
+    costs = compiled.cost_analysis()
+    if isinstance(costs, list):   # older jax returns a per-device list
+        costs = costs[0]
+    return {k: float(v) for k, v in costs.items()
+            if k in ("flops", "bytes accessed", "optimal_seconds")}
+
+
+def flops_report(fn: Callable, *args) -> Tuple[float, str]:
+    costs = cost_analysis(fn, *args)
+    flops = costs.get("flops", float("nan"))
+    return flops, (f"total flops: {flops:,.0f}  "
+                   f"(XLA counts multiply+add as 2 flops)")

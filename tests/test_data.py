@@ -1,0 +1,147 @@
+"""Data pipeline tests: augmentors, dataset readers over synthetic directory
+trees, padding, prefetch loader."""
+
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu.data import (FlowAugmentor, FlyingChairs, MpiSintel,
+                           PairAugmentor, PairList, PrefetchLoader,
+                           batch_samples, batched, pad_to_multiple,
+                           synthetic_batches, unpad)
+from raft_tpu.utils import write_flo
+
+
+def _write_png(path, h=64, w=96, seed=0):
+    import cv2
+    rng = np.random.RandomState(seed)
+    cv2.imwrite(str(path), rng.randint(0, 255, (h, w, 3), np.uint8))
+
+
+def test_pair_augmentor_test_mode_matches_reference_semantics():
+    rng = np.random.RandomState(0)
+    im1 = rng.randint(0, 255, (50, 70, 3), np.uint8)
+    im2 = rng.randint(0, 255, (50, 70, 3), np.uint8)
+    aug = PairAugmentor((32, 48), test_mode=True)
+    o1, o2 = aug(im1, im2)
+    assert o1.shape == (32, 48, 3) and o2.shape == (32, 48, 3)
+    assert 0.0 <= o1.min() and o1.max() <= 1.0
+
+
+def test_pair_augmentor_paired_params():
+    """Photometric params must be IDENTICAL for both frames: feeding the same
+    image twice must give identical outputs (reference test_dataflow.py:71-73)."""
+    rng = np.random.RandomState(1)
+    im = rng.randint(0, 255, (40, 40, 3), np.uint8)
+    aug = PairAugmentor((40, 40), rgb_augmentation=True,
+                        rng=np.random.RandomState(7))
+    o1, o2 = aug(im.copy(), im.copy())
+    np.testing.assert_array_equal(o1, o2)
+
+
+def test_flow_augmentor_flip_consistency():
+    """With flips forced and no scaling/photometric, flow must transform."""
+    h, w = 60, 80
+    rng = np.random.RandomState(2)
+    im1 = rng.randint(0, 255, (h, w, 3), np.uint8)
+    im2 = rng.randint(0, 255, (h, w, 3), np.uint8)
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    flow = np.stack([xs * 0.01, ys * 0.02], -1).astype(np.float32)
+
+    aug = FlowAugmentor((40, 56), min_scale=0.0, max_scale=0.0,
+                        spatial_prob=0.0, stretch_prob=0.0, eraser_prob=0.0,
+                        photometric=False, do_flip=False,
+                        rng=np.random.RandomState(3))
+    a1, a2, aflow, valid = aug(im1, im2, flow)
+    assert a1.shape == (40, 56, 3)
+    assert aflow.shape == (40, 56, 2)
+    assert valid.shape == (40, 56)
+    assert valid.all()
+    # crop only: flow values must be a contiguous subwindow of the original
+    assert np.isin(np.round(aflow[..., 0] / 0.01).astype(int), np.arange(w)).all()
+
+
+def test_flow_augmentor_scale_rescales_flow():
+    h, w = 64, 64
+    rng = np.random.RandomState(4)
+    im = rng.randint(0, 255, (h, w, 3), np.uint8)
+    flow = np.ones((h, w, 2), np.float32)
+    aug = FlowAugmentor((32, 32), min_scale=1.0, max_scale=1.0,
+                        spatial_prob=1.0, stretch_prob=0.0, eraser_prob=0.0,
+                        photometric=False, do_flip=False,
+                        rng=np.random.RandomState(5))
+    _, _, aflow, _ = aug(im, im, flow)
+    np.testing.assert_allclose(aflow, 2.0, rtol=1e-5)   # 2^1 scale doubles flow
+
+
+def test_sintel_dataset(tmp_path):
+    root = tmp_path / "sintel"
+    for scene in ("alley_1", "ambush_2"):
+        (root / "training" / "clean" / scene).mkdir(parents=True)
+        (root / "training" / "flow" / scene).mkdir(parents=True)
+        for i in range(3):
+            _write_png(root / "training" / "clean" / scene / f"frame_{i:04d}.png",
+                       seed=i)
+        for i in range(2):
+            write_flo(np.random.RandomState(i).randn(64, 96, 2).astype(np.float32),
+                      root / "training" / "flow" / scene / f"frame_{i:04d}.flo")
+    ds = MpiSintel(str(root), "training", "clean")
+    assert len(ds) == 4            # 2 scenes x 2 consecutive pairs
+    im1, im2, flow, valid = ds[0]
+    assert im1.shape == (64, 96, 3) and im1.dtype == np.float32
+    assert flow.shape == (64, 96, 2)
+    assert valid.shape == (64, 96)
+    assert im1.max() <= 1.0
+
+
+def test_chairs_dataset_with_split(tmp_path):
+    import cv2
+    root = tmp_path / "chairs"
+    (root / "data").mkdir(parents=True)
+    for i in range(1, 4):
+        for k in (1, 2):
+            cv2.imwrite(str(root / "data" / f"{i:05d}_img{k}.ppm"),
+                        np.random.RandomState(i * k).randint(0, 255, (32, 48, 3), np.uint8))
+        write_flo(np.zeros((32, 48, 2), np.float32),
+                  root / "data" / f"{i:05d}_flow.flo")
+    np.savetxt(root / "chairs_split.txt", [1, 2, 1], fmt="%d")
+    train = FlyingChairs(str(root), "training")
+    val = FlyingChairs(str(root), "validation")
+    assert len(train) == 2 and len(val) == 1
+
+
+def test_pair_list(tmp_path):
+    p1, p2 = tmp_path / "a.png", tmp_path / "b.png"
+    _write_png(p1, seed=1)
+    _write_png(p2, seed=2)
+    ds = PairList([(str(p1), str(p2))], (32, 48))
+    pairs = list(ds)
+    assert len(pairs) == 1
+    assert pairs[0][0].shape == (32, 48, 3)
+
+
+def test_pad_unpad_roundtrip():
+    x = np.random.RandomState(0).rand(1, 43, 101, 3).astype(np.float32)
+    for mode in ("sintel", "kitti"):
+        padded, pads = pad_to_multiple(x, 8, mode)
+        assert padded.shape[1] % 8 == 0 and padded.shape[2] % 8 == 0
+        back = unpad(padded, pads)
+        np.testing.assert_array_equal(back, x)
+
+
+def test_batched_and_prefetch_loader():
+    it = batched(iter([(np.ones(3), np.zeros(2))] * 5), 2)
+    loader = PrefetchLoader(it)
+    batches = list(loader)
+    assert len(batches) == 2                       # drops ragged tail
+    assert batches[0][0].shape == (2, 3)
+    assert float(np.asarray(batches[0][0]).sum()) == 6.0
+
+
+def test_synthetic_batches():
+    it = synthetic_batches(2, (16, 24))
+    im1, im2, flow, valid = next(it)
+    assert im1.shape == (2, 16, 24, 3)
+    assert flow.shape == (2, 16, 24, 2)
+    assert valid.all()

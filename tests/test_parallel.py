@@ -1,0 +1,133 @@
+"""Multi-device tests on the 8-virtual-CPU-device mesh (SURVEY.md §4): DP
+train step equivalence vs single device, halo-exchange convs, distributed
+blockwise correlation, pjit spatial inference."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.config import RAFTConfig, TrainConfig
+from raft_tpu.models import init_raft
+from raft_tpu.models.raft import make_inference_fn
+from raft_tpu.ops import build_pyramid, conv2d, coords_grid, lookup_dense
+from raft_tpu.parallel import (SPATIAL_AXIS, conv2d_row_sharded, halo_exchange,
+                               make_dp_eval_fn, make_dp_train_step, make_mesh,
+                               make_spatial_corr_lookup,
+                               make_spatial_inference_fn, shard_batch)
+from raft_tpu.training import Batch, TrainState, make_optimizer, make_train_step
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8, jax.devices()
+
+
+def _batch(B=8, H=48, W=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return Batch(
+        image1=jnp.asarray(rng.rand(B, H, W, 3), jnp.float32),
+        image2=jnp.asarray(rng.rand(B, H, W, 3), jnp.float32),
+        flow=jnp.asarray(rng.randn(B, H, W, 2) * 2, jnp.float32),
+        valid=jnp.ones((B, H, W), jnp.float32))
+
+
+def test_dp_train_step_matches_single_device():
+    config = RAFTConfig.small_model(iters=2)
+    tconfig = TrainConfig(num_steps=10, lr=1e-4, schedule="constant",
+                          optimizer="sgd")   # sgd: exactly linear in grads
+    tx = make_optimizer(tconfig)
+    state = TrainState.create(init_raft(jax.random.PRNGKey(0), config), tx)
+    batch = _batch()
+    rng = jax.random.PRNGKey(1)
+
+    single = jax.jit(make_train_step(config, tconfig, tx))
+    s1, m1 = single(state, batch, rng)
+
+    mesh = make_mesh()
+    dp = make_dp_train_step(config, tconfig, tx, mesh)
+    sharded = shard_batch(mesh, batch)
+    s8, m8 = dp(state, sharded, rng)
+
+    # pmean of per-shard grads == global grad (equal shard sizes, mean loss)
+    np.testing.assert_allclose(float(m1["loss"]), float(m8["loss"]), rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s8.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=1e-4)
+
+
+def test_dp_eval_fn():
+    config = RAFTConfig.small_model(iters=2)
+    params = init_raft(jax.random.PRNGKey(0), config)
+    mesh = make_mesh()
+    fn = make_dp_eval_fn(config, mesh)
+    batch = _batch()
+    flow = fn(params, batch.image1, batch.image2)
+    assert flow.shape == (8, 48, 64, 2)
+    want = jax.jit(make_inference_fn(config, iters=2))(
+        params, batch.image1, batch.image2)
+    np.testing.assert_allclose(np.asarray(flow), np.asarray(want),
+                               atol=2e-2, rtol=1e-3)
+
+
+def test_halo_exchange_matches_full_conv():
+    """Row-sharded conv with halo exchange == unsharded torch-padding conv."""
+    rng = np.random.RandomState(0)
+    B, H, W, C = 2, 32, 16, 4
+    x = jnp.asarray(rng.randn(B, H, W, C), jnp.float32)
+    w = jnp.asarray(rng.randn(5, 5, C, 8), jnp.float32)
+    want = conv2d(x, w)
+
+    mesh = make_mesh(axes=(SPATIAL_AXIS,))
+    f = jax.shard_map(
+        lambda xl: conv2d_row_sharded(xl, w),
+        mesh=mesh, in_specs=P(None, SPATIAL_AXIS),
+        out_specs=P(None, SPATIAL_AXIS), check_vma=False)
+    got = jax.jit(f)(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_spatial_corr_lookup_matches_dense():
+    rng = np.random.RandomState(1)
+    B, H, W, C = 1, 16, 12, 32
+    f1 = jnp.asarray(rng.randn(B, H, W, C), jnp.float32)
+    f2 = jnp.asarray(rng.randn(B, H, W, C), jnp.float32)
+    coords = coords_grid(B, H, W) + jnp.asarray(
+        rng.uniform(-3, 3, (B, H, W, 2)), jnp.float32)
+    radius, levels = 3, 2
+    want = lookup_dense(build_pyramid(f1, f2, levels), coords, radius)
+
+    mesh = make_mesh(axes=(SPATIAL_AXIS,))
+    fn = make_spatial_corr_lookup(mesh, levels, radius)
+    got = fn(f1, f2, coords)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_spatial_inference_pjit():
+    """Whole model with row-sharded images via jit sharding annotations:
+    XLA SPMD must produce the same flow as single-device."""
+    config = RAFTConfig.small_model(iters=2)
+    params = init_raft(jax.random.PRNGKey(0), config)
+    rng = np.random.RandomState(2)
+    im1 = jnp.asarray(rng.rand(1, 64, 64, 3), jnp.float32)
+    im2 = jnp.asarray(rng.rand(1, 64, 64, 3), jnp.float32)
+    want = jax.jit(make_inference_fn(config))(params, im1, im2)
+
+    mesh = make_mesh(axes=(SPATIAL_AXIS,))
+    fn = make_spatial_inference_fn(config, mesh)
+    got = fn(params, im1, im2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-2, rtol=1e-3)
+
+
+def test_dp_requires_divisible_batch():
+    config = RAFTConfig.small_model(iters=2)
+    mesh = make_mesh()
+    fn = make_dp_eval_fn(config, mesh)
+    params = init_raft(jax.random.PRNGKey(0), config)
+    b = _batch(B=5)
+    with pytest.raises(Exception):
+        fn(params, b.image1, b.image2)

@@ -1,0 +1,148 @@
+"""Tests for host-side flow utilities: viz, I/O, reversal."""
+
+import io
+
+import numpy as np
+import pytest
+
+from raft_tpu.utils import (flow_to_color, make_colorwheel, read_flo,
+                            read_pfm, resize_flow, reverse_flow, write_flo)
+from raft_tpu.utils.frame_utils import _nearest_fill
+
+
+def test_colorwheel_structure():
+    wheel = make_colorwheel()
+    assert wheel.shape == (55, 3)
+    assert wheel.max() == 255
+    np.testing.assert_array_equal(wheel[0], [255, 0, 0])      # pure red start
+    assert (wheel >= 0).all()
+
+
+def _flow_color_oracle(u, v):
+    """Straightforward per-channel loop implementation of the Middlebury
+    coloring (Baker et al. 2007) as an independent oracle."""
+    wheel = make_colorwheel()
+    ncols = wheel.shape[0]
+    img = np.zeros((*u.shape, 3), np.uint8)
+    rad = np.sqrt(u ** 2 + v ** 2)
+    a = np.arctan2(-v, -u) / np.pi
+    fk = (a + 1) / 2 * (ncols - 1) + 1
+    k0 = np.floor(fk).astype(np.int32)
+    k0[k0 > 53] = 53
+    k1 = k0 + 1
+    k1[k1 == ncols] = 1
+    f = fk - k0
+    for i in range(3):
+        col0 = wheel[:, i][k0] / 255.0
+        col1 = wheel[:, i][k1] / 255.0
+        col = (1 - f) * col0 + f * col1
+        idx = rad <= 1
+        col[idx] = 1 - rad[idx] * (1 - col[idx])
+        col[~idx] = col[~idx] * 0.75
+        img[:, :, i] = np.floor(255 * col)
+    return img
+
+
+def test_flow_to_color_matches_oracle():
+    rng = np.random.RandomState(0)
+    flow = rng.randn(20, 30, 2).astype(np.float32) * 5
+    got = flow_to_color(flow)
+    rad = np.sqrt((flow.astype(np.float64) ** 2).sum(-1))
+    norm = flow / (rad.max() + 1e-5)
+    want = _flow_color_oracle(norm[..., 0], norm[..., 1])
+    np.testing.assert_array_equal(got, want)
+    # BGR flips channels
+    np.testing.assert_array_equal(flow_to_color(flow, convert_to_bgr=True),
+                                  want[..., ::-1])
+
+
+def test_flo_roundtrip(tmp_path):
+    rng = np.random.RandomState(1)
+    flow = rng.randn(7, 9, 2).astype(np.float32)
+    p = tmp_path / "t.flo"
+    write_flo(flow, p)
+    np.testing.assert_array_equal(read_flo(p), flow)
+
+
+def test_flo_bad_magic(tmp_path):
+    p = tmp_path / "bad.flo"
+    p.write_bytes(b"XXXX" + b"\0" * 16)
+    with pytest.raises(ValueError, match="magic"):
+        read_flo(p)
+
+
+def test_pfm_read(tmp_path):
+    data = np.arange(12, dtype="<f").reshape(3, 4)
+    p = tmp_path / "t.pfm"
+    with open(p, "wb") as f:
+        f.write(b"Pf\n4 3\n-1.0\n")
+        # PFM stores bottom-up
+        np.flipud(data).astype("<f").tofile(f)
+    out = read_pfm(p)
+    np.testing.assert_array_equal(out, data)
+
+
+def test_resize_flow_scales_values():
+    flow = np.ones((10, 20, 2), np.float32)
+    out = resize_flow(flow, 40, 10)
+    assert out.shape == (10, 40, 2)
+    np.testing.assert_allclose(out[..., 0], 2.0, atol=1e-5)   # W doubled
+    np.testing.assert_allclose(out[..., 1], 1.0, atol=1e-5)   # H unchanged
+
+
+def test_nearest_fill_semantics():
+    values = np.zeros((3, 3, 2))
+    values[0, 0] = [1.0, 2.0]
+    values[2, 2] = [3.0, 4.0]
+    empty = np.ones((3, 3), np.uint8)
+    empty[0, 0] = 0
+    empty[2, 2] = 0
+    out = _nearest_fill(values, empty)
+    # (0,1): left neighbor (0,0) valid; below-scan finds nothing in column 1
+    np.testing.assert_allclose(out[0, 1], [1.0, 2.0])
+    # (2,1): right neighbor (2,2); column 1 has none; row: left none, right (2,2)
+    np.testing.assert_allclose(out[2, 1], [3.0, 4.0])
+    # (1,1): row 1 empty, column 1 empty -> no neighbors -> 0
+    np.testing.assert_allclose(out[1, 1], [0.0, 0.0])
+    # (0,2): row: left (0,0); column: down (2,2) -> average
+    np.testing.assert_allclose(out[0, 2], [2.0, 3.0])
+    # valid pixels untouched
+    np.testing.assert_allclose(out[0, 0], [1.0, 2.0])
+
+
+def _reverse_flow_oracle(flow01):
+    """Per-pixel loop implementation of round-projection splatting."""
+    h, w = flow01.shape[:2]
+    flow10 = np.zeros_like(flow01, dtype=np.float64)
+    count = np.zeros((h, w))
+    for y in range(h):
+        for x in range(w):
+            nx = int(np.clip(np.round(flow01[y, x, 0] + x), 0, w - 1))
+            ny = int(np.clip(np.round(flow01[y, x, 1] + y), 0, h - 1))
+            flow10[ny, nx] += -flow01[y, x]
+            count[ny, nx] += 1
+    nz = count > 0
+    flow10[nz] /= count[nz, None]
+    return flow10, np.uint8(~nz)
+
+
+def test_reverse_flow_matches_oracle():
+    rng = np.random.RandomState(2)
+    flow01 = rng.randn(12, 15, 2).astype(np.float32) * 2.0
+    got = reverse_flow(flow01)
+    want_flow, want_empty = _reverse_flow_oracle(flow01.astype(np.float64))
+    np.testing.assert_array_equal(got.empty, want_empty)
+    hit = ~want_empty.astype(bool)
+    np.testing.assert_allclose(got.flow10[hit], want_flow[hit], atol=1e-5)
+    assert got.flow10.dtype == np.float32
+    # holes were filled where fillable
+    assert np.isfinite(got.flow10).all()
+
+
+def test_reverse_flow_static_skip():
+    flow01 = np.ones((6, 6, 2), np.float32)
+    im0 = np.zeros((6, 6, 3), np.uint8)
+    bg = np.zeros((6, 6, 3), np.uint8)           # everything static
+    out = reverse_flow(flow01, bg=bg, im0=im0)
+    assert out.static_mask.all()
+    assert out.empty.all()                        # nothing projected
