@@ -81,11 +81,18 @@ def main() -> int:
         return B / dt
 
     # candidate tuned configurations; best one is the headline number
-    candidates = ([args.impl] if args.impl else ["dense", "blockwise"])
+    candidates = ([args.impl] if args.impl
+                  else ["dense", "blockwise", "pallas", "pallas-bf16corr"])
+    if jax.default_backend() != "tpu" and not args.impl:
+        # off-TPU the Pallas kernel runs in interpret mode (test-only speed)
+        candidates = [c for c in candidates if not c.startswith("pallas")]
     best_name, best = None, -1.0
     for name in candidates:
         try:
-            cfg = RAFTConfig.full(corr_impl=name, compute_dtype="bfloat16")
+            impl = "pallas" if name.startswith("pallas") else name
+            prec = "default" if name == "pallas-bf16corr" else "highest"
+            cfg = RAFTConfig.full(corr_impl=impl, corr_precision=prec,
+                                  compute_dtype="bfloat16")
             tput = throughput(cfg, args.iters)
             print(f"# {name}+bf16: {tput:.3f} pairs/s", file=sys.stderr)
             if tput > best:

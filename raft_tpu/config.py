@@ -36,6 +36,11 @@ class RAFTConfig:
     # uses the fused TPU kernel (the CUDA-extension equivalent the reference
     # never wrote, reference readme.md:12).
     corr_impl: str = "dense"
+    # MXU precision of the fused kernel's correlation matmul ('highest' =
+    # true-f32 multi-pass, honoring the fp32-corr policy; 'default' = bf16
+    # MXU inputs, matching the dense/blockwise einsum default and ~1.6x
+    # faster). Bilinear-interpolation matmuls always run at highest.
+    corr_precision: str = "highest"
     # Compute dtype for conv/matmul-heavy paths ('float32' or 'bfloat16');
     # the correlation itself always accumulates in float32.
     compute_dtype: str = "float32"

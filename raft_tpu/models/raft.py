@@ -129,7 +129,8 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                 "corr_impl='pallas' requires ops/corr_pallas.py (the fused "
                 "TPU kernel); use 'dense' or 'blockwise'.") from e
         lookup = make_fused_lookup(fmap1c, fmap2c, config.corr_levels,
-                                   config.corr_radius)
+                                   config.corr_radius,
+                                   corr_precision=config.corr_precision)
     else:
         raise ValueError(config.corr_impl)
 

@@ -1,0 +1,236 @@
+"""Fused blockwise correlation + windowed lookup as a Pallas TPU kernel.
+
+This is the framework's stand-in for the reference's never-written CUDA
+correlation extension (reference readme.md:12): the reference materializes the
+full (HW)^2 volume in device memory (reference networks/model_utils.py:206-215,
+~191 MB at 432x1024) and then bilinear-samples 81 points per query from it
+(model_utils.py:224-249). Here the volume never exists in HBM at all.
+
+Design (flash-attention-style, MXU-first):
+
+* Grid ``(B, Q-blocks, P-blocks)``. Each program computes one correlation tile
+  ``f1_block @ f2_block^T / sqrt(C)`` on the MXU — at any instant only a
+  ``[T, Pblk]`` tile lives in VMEM.
+* The (2r+1)^2 bilinear window lookup is *separable*, so it is two more small
+  batched matmuls with one-hot interpolation matrices:
+
+      out[t] = A_x[t] @ (A_y[t] @ corr[t])^T
+
+  where ``A_y[t, j, h] = (1-fy_t)*[h == iy0_t+j] + fy_t*[h == iy0_t+j+1]``
+  (and A_x likewise). Zeros padding outside the map falls out of the one-hot
+  construction for free — an out-of-range index simply never matches — and
+  partial windows straddling a P-block boundary accumulate across the k grid
+  dimension. No per-query scalar loop, no gathers.
+* Backward delegates to the differentiable XLA blockwise implementation
+  (``ops.corr.lookup_ondemand``) via ``custom_vjp``: the forward rides the
+  kernel, gradients ride XLA fusions. (``coords`` is ``stop_gradient``'d
+  upstream anyway — models/raft.py step(), mirroring reference RAFT.py:93.)
+
+Numerics: everything float32 (the bf16-with-fp32-corr policy; outputs match
+``ops.corr.lookup_dense`` to float32 round-off). Off-TPU backends run the
+kernel in Pallas interpret mode so CPU tests exercise identical code.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .corr import fmap2_pyramid, lookup_ondemand
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _level_kernel(f1_ref, coords_ref, f2_ref, out_ref, *, level_scale: float,
+                  corr_scale: float, radius: int, h2_blk: int, w2: int,
+                  corr_precision):
+    """One (batch, query-block, p-block) program: corr tile + window lookup."""
+    n = 2 * radius + 1
+    k = pl.program_id(2)
+    f1 = f1_ref[0]                                   # [T, C]
+    f2 = f2_ref[0]                                   # [Pblk, C]
+    T = f1.shape[0]
+    corr = jax.lax.dot_general(
+        f1, f2, (((1,), (1,)), ((), ())),
+        precision=corr_precision,
+        preferred_element_type=jnp.float32) * corr_scale        # [T, Pblk]
+    corr3 = corr.reshape(T, h2_blk, w2)
+
+    c = coords_ref[0] * level_scale                  # [T, 2] (x, y)
+    cx, cy = c[:, 0], c[:, 1]
+    cx0 = jnp.floor(cx)
+    cy0 = jnp.floor(cy)
+    fx = (cx - cx0)[:, None, None]
+    fy = (cy - cy0)[:, None, None]
+    ix0 = cx0.astype(jnp.int32) - radius
+    iy0 = cy0.astype(jnp.int32) - radius
+
+    # A_y [T, n, h2_blk]: rows of the bilinear window that land in this p-block
+    h_ids = (jax.lax.broadcasted_iota(jnp.int32, (T, n, h2_blk), 2)
+             + k * h2_blk)
+    ty = iy0[:, None, None] + jax.lax.broadcasted_iota(
+        jnp.int32, (T, n, h2_blk), 1)
+    a_y = (jnp.where(h_ids == ty, 1.0 - fy, 0.0)
+           + jnp.where(h_ids == ty + 1, fy, 0.0))
+    # A_x [T, n, W2]
+    w_ids = jax.lax.broadcasted_iota(jnp.int32, (T, n, w2), 2)
+    tx = ix0[:, None, None] + jax.lax.broadcasted_iota(
+        jnp.int32, (T, n, w2), 1)
+    a_x = (jnp.where(w_ids == tx, 1.0 - fx, 0.0)
+           + jnp.where(w_ids == tx + 1, fx, 0.0))
+
+    # interpolation matmuls always run at HIGHEST precision: the bilinear
+    # weights (1-f, f) must not be rounded to bf16 (subpixel flow accuracy),
+    # and these dots are tiny next to the corr matmul.
+    win_y = jax.lax.dot_general(                      # [T, n(y), W2]
+        a_y, corr3, (((2,), (1,)), ((0,), (0,))),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+    win = jax.lax.dot_general(                        # [T, n(x), n(y)]
+        a_x, win_y, (((2,), (2,)), ((0,), (0,))),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+    # x-offset-major [T, n, n]; the flatten to n^2 happens outside the kernel
+    # (Mosaic has no shape cast merging two unaligned minor dims)
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[0] = win
+
+    @pl.when(k > 0)
+    def _():
+        out_ref[0] = out_ref[0] + win
+
+
+def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
+                  radius: int, level: int, *, q_blk: int,
+                  p_blk_target: int, interpret: bool,
+                  corr_precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+    """f1 [B,Q,C], f2_level [B,H2,W2,C], coords [B,Q,2] -> [B,Q,(2r+1)^2]."""
+    B, Q, C = f1.shape
+    _, H2, W2, _ = f2_level.shape
+    n = 2 * radius + 1
+    if H2 == 0 or W2 == 0:
+        # degenerate pyramid level (map pooled away to nothing): every window
+        # is fully out of bounds -> zeros padding
+        return jnp.zeros((B, Q, n * n), jnp.float32)
+
+    T = q_blk if Q >= q_blk else _round_up(Q, 8)
+    Qp = _round_up(Q, T)
+    # pad W2 to lane width so the in-kernel [T, Pblk] -> [T, h2_blk, W2p]
+    # reshape is a supported Mosaic shape cast; padded zero columns correlate
+    # to zero, so any one-hot match on them contributes 0 (= zeros padding) —
+    # and the vector unit would have padded the lanes anyway.
+    W2p = _round_up(W2, 128)
+    h2_blk = max(1, min(H2, p_blk_target // W2p))
+    H2p = _round_up(H2, h2_blk)
+
+    if Qp != Q:
+        f1 = jnp.pad(f1, ((0, 0), (0, Qp - Q), (0, 0)))
+        coords = jnp.pad(coords, ((0, 0), (0, Qp - Q), (0, 0)))
+    f2 = f2_level
+    if H2p != H2 or W2p != W2:
+        # zero rows/cols correlate to zero -> identical to zeros padding at
+        # the image boundary.
+        f2 = jnp.pad(f2, ((0, 0), (0, H2p - H2), (0, W2p - W2), (0, 0)))
+    f2 = f2.reshape(B, H2p * W2p, C)
+
+    grid = (B, Qp // T, H2p // h2_blk)
+    kernel = functools.partial(
+        _level_kernel, level_scale=1.0 / (2.0 ** level),
+        corr_scale=1.0 / (C ** 0.5), radius=radius, h2_blk=h2_blk, w2=W2p,
+        corr_precision=corr_precision)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, T, C), lambda b, j, k: (b, j, 0)),
+            pl.BlockSpec((1, T, 2), lambda b, j, k: (b, j, 0)),
+            pl.BlockSpec((1, h2_blk * W2p, C), lambda b, j, k: (b, k, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, T, n, n), lambda b, j, k: (b, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Qp, n, n), jnp.float32),
+        interpret=interpret,
+    )(f1.astype(jnp.float32), coords.astype(jnp.float32),
+      f2.astype(jnp.float32))
+    out = out.reshape(B, Qp, n * n)
+    return out[:, :Q] if Qp != Q else out
+
+
+def _fused_lookup_impl(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
+                       coords: jax.Array, radius: int,
+                       q_blk: int = 128, p_blk_target: int = 2048,
+                       interpret: Optional[bool] = None,
+                       corr_precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+    B, H, W, C = fmap1.shape
+    Q = H * W
+    interp = _use_interpret() if interpret is None else interpret
+    f1 = fmap1.reshape(B, Q, C)
+    cf = coords.reshape(B, Q, 2)
+    outs = [
+        _lookup_level(f1, f2l, cf, radius, i, q_blk=q_blk,
+                      p_blk_target=p_blk_target, interpret=interp,
+                      corr_precision=corr_precision)
+        for i, f2l in enumerate(f2_levels)
+    ]
+    return jnp.concatenate(outs, axis=-1).reshape(B, H, W, -1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_lookup(fmap1: jax.Array, f2_levels: Tuple[jax.Array, ...],
+                 coords: jax.Array, radius: int,
+                 corr_precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+    """Pallas-fused correlation lookup.
+
+    fmap1 [B,H,W,C], f2_levels tuple of [B,H/2^i,W/2^i,C], coords [B,H,W,2]
+    -> [B, H, W, L*(2r+1)^2], matching ``ops.corr.lookup_dense`` exactly.
+    """
+    return _fused_lookup_impl(fmap1, f2_levels, coords, radius,
+                              corr_precision=corr_precision)
+
+
+def _fused_lookup_fwd(fmap1, f2_levels, coords, radius, corr_precision):
+    return _fused_lookup_impl(fmap1, f2_levels, coords, radius,
+                              corr_precision=corr_precision), (
+        fmap1, f2_levels, coords)
+
+
+def _fused_lookup_bwd(radius, corr_precision, residuals, g):
+    fmap1, f2_levels, coords = residuals
+    _, vjp = jax.vjp(
+        lambda a, b, c: lookup_ondemand(a, list(b), c, radius),
+        fmap1, tuple(f2_levels), coords)
+    return vjp(g)
+
+
+fused_lookup.defvjp(_fused_lookup_fwd, _fused_lookup_bwd)
+
+
+def make_fused_lookup(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
+                      radius: int, corr_precision: str = "highest"):
+    """Build the per-iteration lookup closure used by models/raft.py.
+
+    Pools the fmap2 pyramid once; each GRU iteration then runs the fused
+    kernel — recomputing correlation tiles on the MXU instead of re-reading a
+    ~254 MB volume from HBM (or, at resolutions where that volume could not
+    even be allocated, running where the dense path cannot).
+    """
+    f2_levels = tuple(fmap2_pyramid(fmap2.astype(jnp.float32), num_levels))
+    fmap1 = fmap1.astype(jnp.float32)
+    prec = (jax.lax.Precision.HIGHEST if corr_precision == "highest"
+            else jax.lax.Precision.DEFAULT)
+
+    def lookup(coords: jax.Array) -> jax.Array:
+        return fused_lookup(fmap1, f2_levels, coords, radius, prec)
+
+    return lookup
