@@ -123,6 +123,65 @@ def lookup_dense(pyramid: Sequence[jax.Array], coords: jax.Array, radius: int) -
     return jnp.concatenate(outs, axis=-1).reshape(B, H, W, -1)
 
 
+def _onehot_interp(idx0: jax.Array, frac: jax.Array, n: int, size: int,
+                   offset: int | jax.Array = 0) -> jax.Array:
+    """Separable bilinear selection matrix A [B, Q, n, size]:
+    ``A[b,q,j,p] = (1-frac)*[p+offset == idx0+j] + frac*[p+offset == idx0+j+1]``.
+
+    Out-of-range indices simply never match — zeros padding for free.  The
+    ``offset`` shifts the p-plane (used by ring/partial lookups where only a
+    row-slab of the correlation plane is present).
+    """
+    B, Q = idx0.shape
+    ids = jnp.arange(size, dtype=jnp.int32)[None, None, None, :] + offset
+    tgt = idx0[:, :, None, None] + jnp.arange(n, dtype=jnp.int32)[None, None, :, None]
+    f = frac[:, :, None, None]
+    return (jnp.where(ids == tgt, 1.0 - f, 0.0)
+            + jnp.where(ids == tgt + 1, f, 0.0))
+
+
+def lookup_partial_onehot(corr3: jax.Array, coords: jax.Array, radius: int,
+                          level: int, row_offset: int | jax.Array = 0) -> jax.Array:
+    """Window lookup on a (possibly row-partial) correlation plane, as two
+    one-hot interpolation matmuls (the MXU formulation of bilinear window
+    sampling — same math as the fused Pallas kernel, in plain XLA).
+
+    corr3: [B, Q, Hblk, W2] correlation against rows
+    [row_offset, row_offset + Hblk) of the level-``level`` p-plane;
+    coords: [B, Q, 2] full-resolution (x, y) query coords.
+    Returns [B, Q, (2r+1)^2] in x-offset-major order; contributions from
+    window rows outside the slab are zero, so partial results over a row
+    partition of the plane sum to the full lookup.
+    """
+    B, Q, Hblk, W2 = corr3.shape
+    n = 2 * radius + 1
+    c = coords / (2.0 ** level)
+    cx, cy = c[..., 0], c[..., 1]
+    cx0 = jnp.floor(cx)
+    cy0 = jnp.floor(cy)
+    a_y = _onehot_interp(cy0.astype(jnp.int32) - radius, cy - cy0, n, Hblk,
+                         offset=row_offset)                    # [B,Q,n,Hblk]
+    a_x = _onehot_interp(cx0.astype(jnp.int32) - radius, cx - cx0, n, W2)
+    win_y = jnp.einsum("bqjh,bqhw->bqjw", a_y, corr3,
+                       precision=jax.lax.Precision.HIGHEST,
+                       preferred_element_type=jnp.float32)     # [B,Q,n(y),W2]
+    win = jnp.einsum("bqiw,bqjw->bqij", a_x, win_y,
+                     precision=jax.lax.Precision.HIGHEST,
+                     preferred_element_type=jnp.float32)       # [B,Q,n(x),n(y)]
+    return win.reshape(B, Q, n * n)
+
+
+def lookup_dense_onehot(pyramid: Sequence[jax.Array], coords: jax.Array,
+                        radius: int) -> jax.Array:
+    """Drop-in alternative to ``lookup_dense`` using the one-hot matmul
+    formulation instead of gathers (TPU: MXU work beats take_along_axis)."""
+    B, H, W, _ = coords.shape
+    flat = coords.reshape(B, H * W, 2)
+    outs = [lookup_partial_onehot(corr, flat, radius, i)
+            for i, corr in enumerate(pyramid)]
+    return jnp.concatenate(outs, axis=-1).reshape(B, H, W, -1)
+
+
 def _gather_feature_windows(fmap: jax.Array, ix0: jax.Array, iy0: jax.Array, win: int) -> jax.Array:
     """fmap: [B, H, W, C]; ix0/iy0: [B, T] -> [B, T, win(y), win(x), C], zeros OOB."""
     B, H, W, C = fmap.shape

@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import RAFTConfig
-from ..ops.corr import build_pyramid, lookup_dense
+from ..ops.corr import (build_pyramid, dense_corr, fmap2_pyramid,
+                        lookup_dense, lookup_partial_onehot)
 from .mesh import SPATIAL_AXIS
 
 
@@ -73,6 +74,71 @@ def make_spatial_corr_lookup(mesh: Mesh, num_levels: int, radius: int,
         f2_full = jax.lax.all_gather(f2_local, axis, axis=1, tiled=True)
         pyramid = build_pyramid(f1_local, f2_full, num_levels)
         return lookup_dense(pyramid, coords_local, radius)
+
+    f = jax.shard_map(inner, mesh=mesh,
+                      in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+                      out_specs=P(None, axis),
+                      check_vma=False)
+    return jax.jit(f)
+
+
+def make_ring_corr_lookup(mesh: Mesh, num_levels: int, radius: int,
+                          axis: str = SPATIAL_AXIS):
+    """Ring-pass distributed correlation lookup — the ring-attention analog.
+
+    Unlike :func:`make_spatial_corr_lookup` (which all-gathers fmap2 and
+    holds a [Q/n, HW] volume per device), the ring keeps fmap2 row-sharded:
+    each of the ``n`` steps correlates the local queries against ONE fmap2
+    row-slab ([Q/n, HW/n] tile), accumulates that slab's window
+    contributions via the one-hot partial lookup (zero outside the slab, so
+    partials sum exactly), and ``ppermute``s the slab to the next neighbor —
+    compute overlaps the ICI transfer, peak memory O((HW)^2/n^2) per device.
+
+    Constraints: the image H axis is sharded; H/8 must be divisible by
+    n * 2^(num_levels-1) so every pyramid level pools within its shard.
+
+    Returns jitted (fmap1, fmap2, coords) -> [B, H, W, L*(2r+1)^2] with all
+    arrays row-sharded over ``axis`` on the H axis.
+    """
+
+    def inner(f1_local, f2_local, coords_local):
+        n_dev = jax.lax.axis_size(axis)
+        my = jax.lax.axis_index(axis)
+        B, Hl, W, C = f1_local.shape
+        if Hl % (2 ** (num_levels - 1)) != 0:
+            raise ValueError(
+                f"local H/8 slab {Hl} must be divisible by 2^{num_levels - 1} "
+                f"so pyramid pooling stays shard-local; use fewer devices or "
+                f"pad H (H/8 divisible by n_dev * 2^(levels-1)).")
+        Q = Hl * W
+        flat = coords_local.reshape(B, Q, 2)
+        levels = fmap2_pyramid(f2_local, num_levels)   # shard-local pooling
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+        def contrib(levels, src):
+            outs = []
+            for i, f2l in enumerate(levels):
+                H2l = f2l.shape[1]
+                outs.append(lookup_partial_onehot(
+                    dense_corr(f1_local, f2l), flat, radius, i,
+                    row_offset=src * H2l))
+            return jnp.concatenate(outs, axis=-1)
+
+        def step(carry, _):
+            levels, src, acc = carry
+            acc = acc + contrib(levels, src)
+            # rotate the fmap2 slab pyramid to the next device in the ring
+            # (overlaps with the next step's correlation compute)
+            levels = [jax.lax.ppermute(f2l, axis, perm) for f2l in levels]
+            return (levels, (src - 1) % n_dev, acc), None
+
+        acc0 = jnp.zeros((B, Q, num_levels * (2 * radius + 1) ** 2),
+                         jnp.float32)
+        # n_dev - 1 rotations: the last slab's contribution needs no ppermute
+        (levels, src, acc), _ = jax.lax.scan(step, (levels, my, acc0), None,
+                                             length=n_dev - 1)
+        acc = acc + contrib(levels, src)
+        return acc.reshape(B, Hl, W, -1)
 
     f = jax.shard_map(inner, mesh=mesh,
                       in_specs=(P(None, axis), P(None, axis), P(None, axis)),

@@ -131,3 +131,40 @@ def test_dp_requires_divisible_batch():
     b = _batch(B=5)
     with pytest.raises(Exception):
         fn(params, b.image1, b.image2)
+
+
+def test_ring_corr_lookup_matches_dense():
+    """Ring-pass correlation (ppermute accumulation of one-hot partial
+    lookups) must equal the single-device dense lookup."""
+    from raft_tpu.parallel import make_ring_corr_lookup
+
+    rng = np.random.RandomState(3)
+    B, H, W, C = 1, 32, 12, 16         # H/8-slab analog: 32 rows over 8 devs
+    f1 = jnp.asarray(rng.randn(B, H, W, C), jnp.float32)
+    f2 = jnp.asarray(rng.randn(B, H, W, C), jnp.float32)
+    coords = coords_grid(B, H, W) + jnp.asarray(
+        rng.uniform(-5, 5, (B, H, W, 2)), jnp.float32)
+    radius, levels = 3, 2              # slab 4 rows, level-1 pool shard-local
+    want = lookup_dense(build_pyramid(f1, f2, levels), coords, radius)
+
+    mesh = make_mesh(axes=(SPATIAL_AXIS,))
+    fn = make_ring_corr_lookup(mesh, levels, radius)
+    got = fn(f1, f2, coords)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_onehot_lookup_matches_gather_lookup():
+    from raft_tpu.ops import lookup_dense_onehot
+
+    rng = np.random.RandomState(4)
+    B, H, W, C = 2, 14, 10, 16
+    f1 = jnp.asarray(rng.randn(B, H, W, C), jnp.float32)
+    f2 = jnp.asarray(rng.randn(B, H, W, C), jnp.float32)
+    coords = coords_grid(B, H, W) + jnp.asarray(
+        rng.uniform(-20, 20, (B, H, W, 2)), jnp.float32)
+    pyramid = build_pyramid(f1, f2, 3)
+    want = lookup_dense(pyramid, coords, 4)
+    got = lookup_dense_onehot(pyramid, coords, 4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
