@@ -1,0 +1,375 @@
+// raftio: native host-side runtime for raft-tpu.
+//
+// The reference delegates its host runtime to native code it doesn't own:
+// the TF1 C++ executor + FIFOQueue input pump and tensorpack's ZMQ-backed
+// prefetcher (reference infer_raft.py:37, test_dataflow.py:7), with cv2
+// doing image decode and a pure-Python double loop doing flow reversal
+// (reference flow_utils.py:166-274).  This library is the first-party native
+// equivalent: image decode (libpng/libjpeg), .flo I/O, flow-reversal
+// splatting, and a threaded decode/prefetch pool feeding the JAX input
+// pipeline (the QueueInput/StagingInput analog on the host side).
+//
+// Exposed as a flat C API consumed via ctypes (raft_tpu/native.py); all
+// buffers returned by this library are malloc'd and must be released with
+// raftio_free.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <csetjmp>
+#include <jpeglib.h>
+#include <png.h>
+
+extern "C" {
+
+void raftio_free(void* p) { free(p); }
+
+// ---------------------------------------------------------------- decode --
+
+// Decode PNG or JPEG bytes (detected by magic) to uint8 BGR HWC.
+// Returns 0 on success; *out is malloc'd h*w*3.
+int raftio_decode_image(const uint8_t* bytes, int64_t len,
+                        uint8_t** out, int* h, int* w) {
+  if (len > 8 && png_sig_cmp(bytes, 0, 8) == 0) {
+    png_image im;
+    memset(&im, 0, sizeof im);
+    im.version = PNG_IMAGE_VERSION;
+    if (!png_image_begin_read_from_memory(&im, bytes, (size_t)len)) return -1;
+    im.format = PNG_FORMAT_BGR;
+    uint8_t* buf = (uint8_t*)malloc(PNG_IMAGE_SIZE(im));
+    if (!buf) { png_image_free(&im); return -2; }
+    if (!png_image_finish_read(&im, nullptr, buf, 0, nullptr)) {
+      free(buf);
+      png_image_free(&im);
+      return -3;
+    }
+    *out = buf;
+    *h = (int)im.height;
+    *w = (int)im.width;
+    return 0;
+  }
+  if (len > 2 && bytes[0] == 0xFF && bytes[1] == 0xD8) {   // JPEG SOI
+    struct jpeg_decompress_struct cinfo;
+    struct ErrMgr { jpeg_error_mgr pub; jmp_buf jb; } err;
+    cinfo.err = jpeg_std_error(&err.pub);
+    err.pub.error_exit = [](j_common_ptr c) {
+      longjmp(((ErrMgr*)c->err)->jb, 1);
+    };
+    // volatile: modified between setjmp and longjmp (libjpeg error path)
+    uint8_t* volatile buf = nullptr;
+    if (setjmp(err.jb)) {
+      jpeg_destroy_decompress(&cinfo);
+      free(buf);
+      return -4;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, bytes, (unsigned long)len);
+    jpeg_read_header(&cinfo, TRUE);
+#ifdef JCS_EXTENSIONS
+    cinfo.out_color_space = JCS_EXT_BGR;
+#else
+    cinfo.out_color_space = JCS_RGB;
+#endif
+    jpeg_start_decompress(&cinfo);
+    int W = cinfo.output_width, H = cinfo.output_height;
+    uint8_t* b = (uint8_t*)malloc((size_t)H * W * 3);
+    buf = b;
+    if (!b) { jpeg_destroy_decompress(&cinfo); return -2; }
+    while ((int)cinfo.output_scanline < H) {
+      uint8_t* row = b + (size_t)cinfo.output_scanline * W * 3;
+      jpeg_read_scanlines(&cinfo, &row, 1);
+    }
+    jpeg_finish_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+#ifndef JCS_EXTENSIONS
+    for (int64_t i = 0; i < (int64_t)H * W; i++)    // RGB -> BGR
+      std::swap(buf[i * 3], buf[i * 3 + 2]);
+#endif
+    *out = buf;
+    *h = H;
+    *w = W;
+    return 0;
+  }
+  return -5;   // unknown format
+}
+
+int raftio_decode_file(const char* path, uint8_t** out, int* h, int* w) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -10;
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<uint8_t> bytes((size_t)n);
+  if (fread(bytes.data(), 1, (size_t)n, f) != (size_t)n) {
+    fclose(f);
+    return -11;
+  }
+  fclose(f);
+  return raftio_decode_image(bytes.data(), n, out, h, w);
+}
+
+// ---------------------------------------------------------------- .flo IO --
+
+static const float kFloMagic = 202021.25f;   // "PIEH"
+
+// Read a Middlebury .flo file -> malloc'd float32 [h, w, 2].
+int raftio_read_flo(const char* path, float** out, int* h, int* w) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -10;
+  float magic = 0;
+  int32_t W = 0, H = 0;
+  if (fread(&magic, 4, 1, f) != 1 || magic != kFloMagic ||
+      fread(&W, 4, 1, f) != 1 || fread(&H, 4, 1, f) != 1 ||
+      W <= 0 || H <= 0 || (int64_t)W * H > (int64_t)1 << 30) {
+    fclose(f);
+    return -12;
+  }
+  size_t n = (size_t)W * H * 2;
+  float* buf = (float*)malloc(n * 4);
+  if (!buf) { fclose(f); return -2; }
+  if (fread(buf, 4, n, f) != n) {
+    free(buf);
+    fclose(f);
+    return -11;
+  }
+  fclose(f);
+  *out = buf;
+  *h = H;
+  *w = W;
+  return 0;
+}
+
+int raftio_write_flo(const char* path, const float* data, int h, int w) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return -10;
+  int32_t W = w, H = h;
+  size_t n = (size_t)w * h * 2;
+  int ok = fwrite(&kFloMagic, 4, 1, f) == 1 && fwrite(&W, 4, 1, f) == 1 &&
+           fwrite(&H, 4, 1, f) == 1 && fwrite(data, 4, n, f) == n;
+  fclose(f);
+  return ok ? 0 : -13;
+}
+
+// ---------------------------------------------------------- flow reversal --
+
+// Forward flow -> backward flow by splatting each source pixel to its
+// rounded target with conflict averaging, then nearest-neighbor hole fill
+// (average of the nearest ORIGINAL non-empty pixel in each of the four
+// directions).  Matches raft_tpu.utils.frame_utils.reverse_flow (itself the
+// re-design of the reference's per-pixel Python loops,
+// reference flow_utils.py:166-274).
+//
+// flow01: float32 [h, w, 2]; skip: optional uint8 [h, w] (1 = static, skip);
+// outputs (caller-allocated): flow10 float32 [h, w, 2], empty uint8 [h, w]
+// (no projection landed, pre-fill), conflict uint8 [h, w] (>1 landed).
+int raftio_reverse_flow(const float* flow01, int h, int w, float time_step,
+                        const uint8_t* skip, float* flow10, uint8_t* empty,
+                        uint8_t* conflict) {
+  int64_t n = (int64_t)h * w;
+  std::vector<double> acc(n * 2, 0.0);
+  std::vector<double> cnt(n, 0.0);
+  for (int y = 0; y < h; y++) {
+    for (int x = 0; x < w; x++) {
+      int64_t i = (int64_t)y * w + x;
+      if (skip && skip[i]) continue;
+      double fx = (double)flow01[i * 2] * time_step;
+      double fy = (double)flow01[i * 2 + 1] * time_step;
+      long tx = lrint(fx + x);
+      long ty = lrint(fy + y);
+      tx = tx < 0 ? 0 : (tx > w - 1 ? w - 1 : tx);
+      ty = ty < 0 ? 0 : (ty > h - 1 ? h - 1 : ty);
+      int64_t t = (int64_t)ty * w + tx;
+      acc[t * 2] -= fx;
+      acc[t * 2 + 1] -= fy;
+      cnt[t] += 1.0;
+    }
+  }
+  std::vector<double> val(n * 2);
+  for (int64_t i = 0; i < n; i++) {
+    if (cnt[i] > 1e-7) {
+      val[i * 2] = acc[i * 2] / cnt[i];
+      val[i * 2 + 1] = acc[i * 2 + 1] / cnt[i];
+      empty[i] = 0;
+    } else {
+      val[i * 2] = val[i * 2 + 1] = 0.0;
+      empty[i] = 1;
+    }
+    conflict[i] = cnt[i] > 1.0 ? 1 : 0;
+  }
+
+  // nearest-fill: per empty pixel, average the nearest original non-empty
+  // value in each of up/down/left/right.
+  std::vector<double> facc(n * 2, 0.0);
+  std::vector<uint8_t> fcnt(n, 0);
+  auto scan = [&](bool cols, bool rev) {
+    int outer = cols ? w : h;
+    int inner = cols ? h : w;
+    for (int o = 0; o < outer; o++) {
+      int64_t last = -1;
+      for (int ii = 0; ii < inner; ii++) {
+        int i2 = rev ? inner - 1 - ii : ii;
+        int64_t idx = cols ? (int64_t)i2 * w + o : (int64_t)o * w + i2;
+        if (!empty[idx]) {
+          last = idx;
+        } else if (last >= 0) {
+          facc[idx * 2] += val[last * 2];
+          facc[idx * 2 + 1] += val[last * 2 + 1];
+          fcnt[idx]++;
+        }
+      }
+    }
+  };
+  scan(false, false);
+  scan(false, true);
+  scan(true, false);
+  scan(true, true);
+  for (int64_t i = 0; i < n; i++) {
+    if (empty[i] && fcnt[i]) {
+      val[i * 2] = facc[i * 2] / fcnt[i];
+      val[i * 2 + 1] = facc[i * 2 + 1] / fcnt[i];
+    }
+    flow10[i * 2] = (float)val[i * 2];
+    flow10[i * 2 + 1] = (float)val[i * 2 + 1];
+  }
+  return 0;
+}
+
+// ----------------------------------------------------------- decode pool --
+
+// Threaded image-pair decode pool: the native analog of the reference's
+// QueueInput pump thread + PrefetchDataZMQ worker processes.  Jobs are
+// (path1, path2) pairs; results come back in completion order with the
+// caller's tag.  Bounded: submit blocks when `capacity` results are pending.
+struct PoolResult {
+  int64_t tag;
+  int status;
+  uint8_t *im1, *im2;
+  int h1, w1, h2, w2;
+};
+
+struct PoolJob {
+  int64_t tag;
+  char *path1, *path2;
+};
+
+struct Pool {
+  std::mutex mu;
+  std::condition_variable cv_job, cv_res, cv_room;
+  std::deque<PoolJob> jobs;
+  std::deque<PoolResult> results;
+  std::vector<std::thread> workers;
+  int capacity;
+  int inflight = 0;     // submitted, result not yet consumed
+  bool stop = false;
+};
+
+static void pool_worker(Pool* p) {
+  for (;;) {
+    PoolJob job;
+    {
+      std::unique_lock<std::mutex> lk(p->mu);
+      p->cv_job.wait(lk, [&] { return p->stop || !p->jobs.empty(); });
+      if (p->stop && p->jobs.empty()) return;
+      job = p->jobs.front();
+      p->jobs.pop_front();
+    }
+    PoolResult r{};
+    r.tag = job.tag;
+    r.status = raftio_decode_file(job.path1, &r.im1, &r.h1, &r.w1);
+    if (r.status == 0) {
+      int s2 = raftio_decode_file(job.path2, &r.im2, &r.h2, &r.w2);
+      if (s2 != 0) {
+        free(r.im1);
+        r.im1 = nullptr;
+        r.status = s2;
+      }
+    }
+    free(job.path1);
+    free(job.path2);
+    {
+      std::lock_guard<std::mutex> lk(p->mu);
+      p->results.push_back(r);
+    }
+    p->cv_res.notify_one();
+  }
+}
+
+void* raftio_pool_create(int workers, int capacity) {
+  Pool* p = new Pool();
+  p->capacity = capacity > 0 ? capacity : 4;
+  if (workers < 1) workers = 1;
+  for (int i = 0; i < workers; i++)
+    p->workers.emplace_back(pool_worker, p);
+  return p;
+}
+
+// Blocks while `capacity` results are already pending (backpressure).
+int raftio_pool_submit(void* pool, const char* path1, const char* path2,
+                       int64_t tag) {
+  Pool* p = (Pool*)pool;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_room.wait(lk, [&] { return p->stop || p->inflight < p->capacity; });
+    if (p->stop) return -20;
+    p->inflight++;
+    p->jobs.push_back(PoolJob{tag, strdup(path1), strdup(path2)});
+  }
+  p->cv_job.notify_one();
+  return 0;
+}
+
+// Blocks until a result is ready.  Returns the job's decode status (0 = ok);
+// on ok, *im1/*im2 are malloc'd BGR HWC buffers owned by the caller.
+int raftio_pool_next(void* pool, int64_t* tag, uint8_t** im1, int* h1,
+                     int* w1, uint8_t** im2, int* h2, int* w2) {
+  Pool* p = (Pool*)pool;
+  PoolResult r;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_res.wait(lk, [&] { return p->stop || !p->results.empty(); });
+    if (p->results.empty()) return -20;
+    r = p->results.front();
+    p->results.pop_front();
+    p->inflight--;
+  }
+  p->cv_room.notify_one();
+  *tag = r.tag;
+  *im1 = r.im1;
+  *im2 = r.im2;
+  *h1 = r.h1;
+  *w1 = r.w1;
+  *h2 = r.h2;
+  *w2 = r.w2;
+  return r.status;
+}
+
+void raftio_pool_destroy(void* pool) {
+  Pool* p = (Pool*)pool;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+  }
+  p->cv_job.notify_all();
+  p->cv_res.notify_all();
+  p->cv_room.notify_all();
+  for (auto& t : p->workers) t.join();
+  for (auto& r : p->results) {
+    free(r.im1);
+    free(r.im2);
+  }
+  for (auto& j : p->jobs) {
+    free(j.path1);
+    free(j.path2);
+  }
+  delete p;
+}
+
+}  // extern "C"

@@ -18,9 +18,21 @@ from ..utils.flow_io import read_flo, read_kitti_flow, read_pfm
 from .augment import FlowAugmentor, PairAugmentor
 
 
+_PNG_MAGIC = b"\x89PNG"
+_JPEG_MAGIC = b"\xff\xd8"
+
+
 def _read_image(path) -> np.ndarray:
+    from .. import native
+    with open(path, "rb") as f:             # BGR, reference convention
+        data = f.read()
+    if data.startswith((_PNG_MAGIC, _JPEG_MAGIC)) and native.available():
+        try:
+            return native.decode_image(data)
+        except ValueError:
+            pass                            # corrupt header: let cv2 try
     import cv2
-    im = cv2.imread(str(path), cv2.IMREAD_COLOR)   # BGR, reference convention
+    im = cv2.imdecode(np.frombuffer(data, np.uint8), cv2.IMREAD_COLOR)
     if im is None:
         raise FileNotFoundError(path)
     return im

@@ -1,0 +1,103 @@
+"""Tests for the raftio native host-runtime library (native/raftio.cpp via
+raft_tpu/native.py): decode parity vs cv2, .flo round-trip vs the Python
+reader, flow-reversal parity vs the vectorized numpy implementation, and the
+threaded decode pool.  Skipped wholesale if the toolchain can't build it."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="raftio native library unavailable")
+
+ASSET = "assets/frame_0016.png"
+
+
+def test_decode_png_matches_cv2():
+    cv2 = pytest.importorskip("cv2")
+    data = open(ASSET, "rb").read()
+    got = native.decode_image(data)
+    want = cv2.imdecode(np.frombuffer(data, np.uint8), cv2.IMREAD_COLOR)
+    assert got.shape == want.shape
+    np.testing.assert_array_equal(got, want)
+
+
+def test_decode_jpeg_close_to_cv2(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    im = cv2.imread(ASSET)
+    path = str(tmp_path / "x.jpg")
+    cv2.imwrite(path, im, [cv2.IMWRITE_JPEG_QUALITY, 95])
+    data = open(path, "rb").read()
+    got = native.decode_image(data).astype(np.int16)
+    want = cv2.imdecode(np.frombuffer(data, np.uint8),
+                        cv2.IMREAD_COLOR).astype(np.int16)
+    assert got.shape == want.shape
+    # IDCT implementations may differ by a bit or two per sample
+    assert np.mean(np.abs(got - want)) < 1.0
+    assert np.max(np.abs(got - want)) <= 16
+
+
+def test_flo_roundtrip(tmp_path):
+    from raft_tpu.utils.flow_io import read_flo as py_read_flo
+    from raft_tpu.utils.flow_io import write_flo as py_write_flo
+
+    rng = np.random.RandomState(0)
+    flow = rng.randn(31, 17, 2).astype(np.float32) * 20
+    p1 = tmp_path / "a.flo"
+    p2 = tmp_path / "b.flo"
+    native.write_flo(flow, p1)
+    np.testing.assert_array_equal(native.read_flo(p1), flow)
+    # cross-compatibility with the Python implementation both ways
+    np.testing.assert_array_equal(py_read_flo(p1), flow)
+    py_write_flo(flow, p2)
+    np.testing.assert_array_equal(native.read_flo(p2), flow)
+
+
+def test_reverse_flow_matches_numpy():
+    from raft_tpu.utils.frame_utils import reverse_flow as py_reverse_flow
+
+    rng = np.random.RandomState(1)
+    flow = (rng.rand(40, 56, 2).astype(np.float32) - 0.5) * 24
+    want = py_reverse_flow(flow)
+    got_flow, got_empty, got_conflict = native.reverse_flow(flow)
+    np.testing.assert_array_equal(got_empty, want.empty_before_fill)
+    np.testing.assert_array_equal(got_conflict, want.conflict)
+    np.testing.assert_allclose(got_flow, want.flow10, atol=1e-5)
+
+
+def test_reverse_flow_with_skip_mask():
+    from raft_tpu.utils.frame_utils import reverse_flow as py_reverse_flow
+
+    rng = np.random.RandomState(2)
+    h, w = 24, 32
+    flow = (rng.rand(h, w, 2).astype(np.float32) - 0.5) * 10
+    # static background equality mask via the Python path
+    im0 = rng.randint(0, 255, (h, w, 3)).astype(np.float64)
+    bg = im0.copy()
+    bg[: h // 2] += 50          # bottom half static
+    want = py_reverse_flow(flow, bg=bg, im0=im0)
+    skip = want.static_mask[:, :, 0].astype(np.uint8)
+    got_flow, got_empty, _ = native.reverse_flow(flow, skip=skip)
+    np.testing.assert_array_equal(got_empty, want.empty_before_fill)
+    np.testing.assert_allclose(got_flow, want.flow10, atol=1e-5)
+
+
+def test_decode_pool_stream():
+    cv2 = pytest.importorskip("cv2")
+    want = cv2.imread(ASSET)
+    pairs = [(ASSET, ASSET)] * 5
+    seen = set()
+    with native.DecodePool(workers=2, capacity=3) as pool:
+        for tag, im1, im2 in pool.stream(pairs):
+            seen.add(tag)
+            np.testing.assert_array_equal(im1, want)
+            np.testing.assert_array_equal(im2, want)
+    assert seen == set(range(5))
+
+
+def test_decode_pool_error_status(tmp_path):
+    with native.DecodePool(workers=1, capacity=2) as pool:
+        pool.submit(tmp_path / "missing1.png", tmp_path / "missing2.png", 7)
+        with pytest.raises(RuntimeError):
+            pool.next()
