@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import RAFTConfig
+from ..ops import spmd
 from ..ops.coords import coords_grid, upflow8
 from ..ops.corr import build_pyramid, fmap2_pyramid, lookup_dense, lookup_ondemand
 from ..ops.upsample import convex_upsample_flow
@@ -114,7 +115,14 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
     fmap1c = fmap1.astype(jnp.float32)
     fmap2c = fmap2.astype(jnp.float32)
 
-    if config.corr_impl == "dense":
+    if spmd.spatial_axis() is not None:
+        # row-sharded run (make_shard_inference_fn): correlation must see the
+        # full fmap2, which lives sharded across devices -> ring pass
+        from ..parallel.spatial import make_ring_lookup_local
+        lookup = make_ring_lookup_local(fmap1c, fmap2c, config.corr_levels,
+                                        config.corr_radius,
+                                        spmd.spatial_axis())
+    elif config.corr_impl == "dense":
         pyramid = build_pyramid(fmap1c, fmap2c, config.corr_levels)
         lookup = functools.partial(lookup_dense, pyramid, radius=config.corr_radius)
     elif config.corr_impl == "blockwise":
@@ -141,6 +149,11 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
     inp = jax.nn.relu(cnet[..., config.hidden_dim:])
 
     coords0 = coords_grid(B, h, w)
+    if spmd.spatial_axis() is not None:
+        # local slab -> global pixel coordinates (queries address the global
+        # correlation plane)
+        off = jax.lax.axis_index(spmd.spatial_axis()) * h
+        coords0 = coords0.at[..., 1].add(off.astype(coords0.dtype))
     coords1 = coords0 if flow_init is None else coords0 + flow_init
 
     def upsample(flow_lr: jax.Array, mask: Optional[jax.Array]) -> jax.Array:

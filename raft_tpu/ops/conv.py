@@ -16,6 +16,8 @@ from typing import Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from . import spmd
+
 _DIMNUMS = ("NHWC", "HWIO", "NHWC")
 
 KernelSize = Union[int, Tuple[int, int]]
@@ -30,12 +32,19 @@ def conv2d(x: jax.Array, w: jax.Array, b: jax.Array | None = None,
     """Convolution with symmetric torch-style padding.
 
     x: [B, H, W, Cin]; w: [kh, kw, Cin, Cout]; b: [Cout] or None.
+    Inside an active ``spmd.spatial_sharding`` context the H padding comes
+    from a halo exchange with the neighbor shards instead of zeros, so
+    row-sharded activations convolve identically to the unsharded model.
     """
     kh, kw = w.shape[0], w.shape[1]
-    pad = ((kh // 2, kh // 2), (kw // 2, kw // 2))
     if compute_dtype is not None:
         x = x.astype(compute_dtype)
         w = w.astype(compute_dtype)
+    if spmd.spatial_axis() is not None and kh > 1:
+        x = spmd.halo_exchange(x, kh // 2)
+        pad = ((0, 0), (kw // 2, kw // 2))
+    else:
+        pad = ((kh // 2, kh // 2), (kw // 2, kw // 2))
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=(stride, stride), padding=pad,
         dimension_numbers=_DIMNUMS)

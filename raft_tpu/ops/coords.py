@@ -9,10 +9,10 @@ onto the MXU by XLA — the TPU-friendly formulation of an image resize.
 
 from __future__ import annotations
 
-
-
 import jax
 import jax.numpy as jnp
+
+from . import spmd
 
 
 def coords_grid(batch: int, ht: int, wd: int, dtype=jnp.float32) -> jax.Array:
@@ -38,12 +38,46 @@ def _interp_matrix(n_in: int, n_out: int, dtype):
     return m.astype(dtype)
 
 
+def _interp_rows_sharded(h_local: int, factor: int, axis_name: str) -> jax.Array:
+    """Align-corners row-interpolation weights for one shard of a row-sharded
+    ×``factor`` resize: [h_local*factor, h_local+2] against the halo-padded
+    (one row each side) local input.  The positions depend on the *global*
+    height and this shard's offset; out-of-slab indices never match the
+    one-hot comparison, and the analysis bounds every source row within the
+    1-row halo."""
+    n = jax.lax.axis_size(axis_name)
+    s = jax.lax.axis_index(axis_name)
+    hg = h_local * n
+    scale = (hg - 1) / (hg * factor - 1)
+    o = jnp.arange(h_local * factor, dtype=jnp.float32) + (
+        s * (h_local * factor)).astype(jnp.float32)
+    pos = o * scale
+    i0 = jnp.floor(pos)
+    f = pos - i0
+    i0_local = i0.astype(jnp.int32) - s * h_local + 1    # halo offset
+    ids = jnp.arange(h_local + 2, dtype=jnp.int32)[None, :]
+    return (jnp.where(ids == i0_local[:, None], 1.0 - f[:, None], 0.0)
+            + jnp.where(ids == i0_local[:, None] + 1, f[:, None], 0.0))
+
+
 def resize_bilinear_align_corners(x: jax.Array, out_h: int, out_w: int) -> jax.Array:
-    """Exact align-corners bilinear resize of [B, H, W, C] via separable matmuls."""
+    """Exact align-corners bilinear resize of [B, H, W, C] via separable
+    matmuls.  Row-sharded (inside ``spmd.spatial_sharding``): H is the local
+    slab height, ``out_h`` the local output height, and the row weights are
+    built against this shard's global offset with a 1-row halo."""
     B, H, W, C = x.shape
-    my = _interp_matrix(H, out_h, x.dtype)   # [OH, H]
-    mx = _interp_matrix(W, out_w, x.dtype)   # [OW, W]
-    x = jnp.einsum("oh,bhwc->bowc", my, x)
+    ax = spmd.spatial_axis()
+    if ax is not None:
+        if out_h % H:
+            raise ValueError(f"sharded resize needs integer row factor, got "
+                             f"{H} -> {out_h}")
+        xp = spmd.halo_exchange(x, 1)
+        my = _interp_rows_sharded(H, out_h // H, ax).astype(x.dtype)
+        x = jnp.einsum("oh,bhwc->bowc", my, xp)
+    else:
+        my = _interp_matrix(H, out_h, x.dtype)   # [OH, H]
+        x = jnp.einsum("oh,bhwc->bowc", my, x)
+    mx = _interp_matrix(W, out_w, x.dtype)       # [OW, W]
     x = jnp.einsum("pw,bowc->bopc", mx, x)
     return x
 

@@ -19,6 +19,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from . import spmd
+
 EPS = 1e-5
 
 
@@ -31,7 +33,17 @@ def instance_norm(x: jax.Array, gamma: jax.Array | None = None,
     PyTorch's default ``nn.InstanceNorm2d(affine=False)``.
     """
     mean = jnp.mean(x, axis=(1, 2), keepdims=True)
-    var = jnp.var(x, axis=(1, 2), keepdims=True)
+    ax = spmd.spatial_axis()
+    if ax is not None:
+        # row-sharded: statistics over the full image via psum (equal-size
+        # shards, so the mean of shard means is the global mean)
+        mean = jax.lax.pmean(mean, ax)
+        mean2 = jax.lax.pmean(jnp.mean(jnp.square(x), axis=(1, 2),
+                                       keepdims=True), ax)
+        # E[x^2]-mean^2 can cancel slightly negative in f32 -> NaN via rsqrt
+        var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    else:
+        var = jnp.var(x, axis=(1, 2), keepdims=True)
     out = (x - mean) * jax.lax.rsqrt(var + eps)
     if gamma is not None:
         out = out * gamma
@@ -47,7 +59,14 @@ def group_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
     assert C % num_groups == 0, (C, num_groups)
     xg = x.reshape(B, H, W, num_groups, C // num_groups)
     mean = jnp.mean(xg, axis=(1, 2, 4), keepdims=True)
-    var = jnp.var(xg, axis=(1, 2, 4), keepdims=True)
+    ax = spmd.spatial_axis()
+    if ax is not None:
+        mean = jax.lax.pmean(mean, ax)
+        mean2 = jax.lax.pmean(jnp.mean(jnp.square(xg), axis=(1, 2, 4),
+                                       keepdims=True), ax)
+        var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    else:
+        var = jnp.var(xg, axis=(1, 2, 4), keepdims=True)
     xg = (xg - mean) * jax.lax.rsqrt(var + eps)
     return xg.reshape(B, H, W, C) * gamma + beta
 

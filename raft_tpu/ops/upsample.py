@@ -12,13 +12,20 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from . import spmd
+
 
 def _shift_stack_3x3(x: jax.Array) -> jax.Array:
     """[B, H, W, C] -> [B, H, W, 9, C]: zero-padded 3x3 neighborhoods,
     tap order row-major (dy, dx) to match both ``tf.extract_image_patches``
-    and PyTorch ``F.unfold``."""
+    and PyTorch ``F.unfold``.  Row-sharded: the H padding rows come from the
+    neighbor shards via halo exchange."""
     B, H, W, C = x.shape
-    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    if spmd.spatial_axis() is not None:
+        xp = spmd.halo_exchange(x, 1)
+        xp = jnp.pad(xp, ((0, 0), (0, 0), (1, 1), (0, 0)))
+    else:
+        xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
     taps = [xp[:, dy:dy + H, dx:dx + W, :] for dy in range(3) for dx in range(3)]
     return jnp.stack(taps, axis=3)
 

@@ -18,33 +18,17 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import RAFTConfig
+from ..ops import spmd as _spmd
 from ..ops.corr import (build_pyramid, dense_corr, fmap2_pyramid,
                         lookup_dense, lookup_partial_onehot)
 from .mesh import SPATIAL_AXIS
 
 
 def halo_exchange(x: jax.Array, halo: int, axis_name: str = SPATIAL_AXIS) -> jax.Array:
-    """Pad the H axis (axis 1 of [B, H, W, C]) of a row-sharded block with
-    ``halo`` rows from the neighboring shards (zeros at the outer edges, i.e.
-    the image boundary — matching torch zero padding).
-
-    Returns [B, H + 2*halo, W, C]."""
-    if halo == 0:
-        return x
-    n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
-    top = x[:, :halo]          # my top rows -> previous device's bottom halo
-    bot = x[:, -halo:]         # my bottom rows -> next device's top halo
-    # from next device: its top rows become my bottom halo
-    from_next = jax.lax.ppermute(top, axis_name,
-                                 [(i, (i - 1) % n) for i in range(n)])
-    # from previous device: its bottom rows become my top halo
-    from_prev = jax.lax.ppermute(bot, axis_name,
-                                 [(i, (i + 1) % n) for i in range(n)])
-    zeros = jnp.zeros_like(top)
-    top_halo = jnp.where(idx == 0, zeros, from_prev)
-    bot_halo = jnp.where(idx == n - 1, zeros, from_next)
-    return jnp.concatenate([top_halo, x, bot_halo], axis=1)
+    """Neighbor-row halo padding of a row-sharded block; the single
+    implementation lives in ops.spmd (re-exported here with the spatial-axis
+    default for shard_map users)."""
+    return _spmd.halo_exchange(x, halo, axis_name)
 
 
 def conv2d_row_sharded(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None,
@@ -82,38 +66,33 @@ def make_spatial_corr_lookup(mesh: Mesh, num_levels: int, radius: int,
     return jax.jit(f)
 
 
-def make_ring_corr_lookup(mesh: Mesh, num_levels: int, radius: int,
-                          axis: str = SPATIAL_AXIS):
-    """Ring-pass distributed correlation lookup — the ring-attention analog.
+def make_ring_lookup_local(f1_local: jax.Array, f2_local: jax.Array,
+                           num_levels: int, radius: int, axis: str):
+    """Build a per-iteration ring-pass correlation lookup closure for use
+    INSIDE an existing shard_map over ``axis`` (fmap1/fmap2/coords all
+    row-sharded slabs, coords in global pixel units).
 
-    Unlike :func:`make_spatial_corr_lookup` (which all-gathers fmap2 and
-    holds a [Q/n, HW] volume per device), the ring keeps fmap2 row-sharded:
-    each of the ``n`` steps correlates the local queries against ONE fmap2
-    row-slab ([Q/n, HW/n] tile), accumulates that slab's window
-    contributions via the one-hot partial lookup (zero outside the slab, so
-    partials sum exactly), and ``ppermute``s the slab to the next neighbor —
-    compute overlaps the ICI transfer, peak memory O((HW)^2/n^2) per device.
-
-    Constraints: the image H axis is sharded; H/8 must be divisible by
-    n * 2^(num_levels-1) so every pyramid level pools within its shard.
-
-    Returns jitted (fmap1, fmap2, coords) -> [B, H, W, L*(2r+1)^2] with all
-    arrays row-sharded over ``axis`` on the H axis.
+    Each call runs the ring: correlate the local queries against one fmap2
+    row-slab at a time ([Q/n, HW/n] tile on the MXU), accumulate that slab's
+    window contributions via the one-hot partial lookup (zero outside the
+    slab, so partials sum exactly), and ``ppermute`` the slab pyramid to the
+    next neighbor — n-1 rotations, compute overlapping the ICI transfer,
+    peak memory O((HW)^2/n^2) per device.
     """
+    n_dev = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    B, Hl, W, C = f1_local.shape
+    if Hl % (2 ** (num_levels - 1)) != 0:
+        raise ValueError(
+            f"local H/8 slab {Hl} must be divisible by 2^{num_levels - 1} "
+            f"so pyramid pooling stays shard-local; use fewer devices or "
+            f"pad H (H/8 divisible by n_dev * 2^(levels-1)).")
+    Q = Hl * W
+    levels0 = fmap2_pyramid(f2_local, num_levels)     # shard-local pooling
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
-    def inner(f1_local, f2_local, coords_local):
-        n_dev = jax.lax.axis_size(axis)
-        my = jax.lax.axis_index(axis)
-        B, Hl, W, C = f1_local.shape
-        if Hl % (2 ** (num_levels - 1)) != 0:
-            raise ValueError(
-                f"local H/8 slab {Hl} must be divisible by 2^{num_levels - 1} "
-                f"so pyramid pooling stays shard-local; use fewer devices or "
-                f"pad H (H/8 divisible by n_dev * 2^(levels-1)).")
-        Q = Hl * W
-        flat = coords_local.reshape(B, Q, 2)
-        levels = fmap2_pyramid(f2_local, num_levels)   # shard-local pooling
-        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+    def lookup(coords: jax.Array) -> jax.Array:
+        flat = coords.reshape(B, Q, 2)
 
         def contrib(levels, src):
             outs = []
@@ -134,14 +113,61 @@ def make_ring_corr_lookup(mesh: Mesh, num_levels: int, radius: int,
 
         acc0 = jnp.zeros((B, Q, num_levels * (2 * radius + 1) ** 2),
                          jnp.float32)
-        # n_dev - 1 rotations: the last slab's contribution needs no ppermute
-        (levels, src, acc), _ = jax.lax.scan(step, (levels, my, acc0), None,
+        # n_dev - 1 rotations: the last slab needs no ppermute
+        (levels, src, acc), _ = jax.lax.scan(step, (levels0, my, acc0), None,
                                              length=n_dev - 1)
         acc = acc + contrib(levels, src)
         return acc.reshape(B, Hl, W, -1)
 
+    return lookup
+
+
+def make_ring_corr_lookup(mesh: Mesh, num_levels: int, radius: int,
+                          axis: str = SPATIAL_AXIS):
+    """Standalone jitted ring-pass correlation lookup — the ring-attention
+    analog (see :func:`make_ring_lookup_local`): (fmap1, fmap2, coords) ->
+    [B, H, W, L*(2r+1)^2], all arrays row-sharded over ``axis``."""
+
+    def inner(f1_local, f2_local, coords_local):
+        lookup = make_ring_lookup_local(f1_local, f2_local, num_levels,
+                                        radius, axis)
+        return lookup(coords_local)
+
     f = jax.shard_map(inner, mesh=mesh,
                       in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+                      out_specs=P(None, axis),
+                      check_vma=False)
+    return jax.jit(f)
+
+
+def make_shard_inference_fn(config: RAFTConfig, mesh: Mesh,
+                            iters: Optional[int] = None,
+                            axis: str = SPATIAL_AXIS):
+    """Whole-model row-sharded inference via shard_map — the full
+    sequence-parallel path, explicit-collectives edition of
+    :func:`make_spatial_inference_fn`.
+
+    The unchanged model code runs under ``ops.spmd.spatial_sharding``:
+    convolutions halo-exchange boundary rows, instance norms psum their
+    statistics, upsampling fetches one-row halos, and the correlation runs
+    the ring pass (``make_ring_lookup_local``) — no (HW)^2/n volume, no
+    fmap2 all-gather.  Constraints: H divisible by
+    8 * n_devices * 2^(corr_levels-1).
+
+    Returns jitted (params, image1, image2) -> flow, images/flow row-sharded
+    over ``axis``.
+    """
+    from ..models.raft import raft_forward
+    from ..ops import spmd
+
+    def fwd(params, image1, image2):
+        with spmd.spatial_sharding(axis):
+            out, _ = raft_forward(params, image1, image2, config,
+                                  iters=iters, train=False, all_flows=False)
+        return out.flow
+
+    f = jax.shard_map(fwd, mesh=mesh,
+                      in_specs=(P(), P(None, axis), P(None, axis)),
                       out_specs=P(None, axis),
                       check_vma=False)
     return jax.jit(f)
