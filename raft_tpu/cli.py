@@ -52,6 +52,10 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--save-flo", action="store_true", help="also write .flo")
     p.add_argument("--show", action="store_true", help="cv2.imshow the result")
     p.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="write a jax.profiler trace (XPlane, viewable in "
+                        "TensorBoard/Perfetto) of the steady-state run "
+                        "(test mode) or steps 5-8 (train mode)")
     # dataset / training flags
     p.add_argument("--data", default=None, help="dataset root directory")
     p.add_argument("--dataset", default="sintel",
@@ -126,8 +130,13 @@ def mode_test(args) -> int:
     t0 = time.time()
     flow = np.asarray(fn(params, jnp.asarray(im1), jnp.asarray(im2)))
     t1 = time.time()
+    if args.trace:
+        jax.profiler.start_trace(args.trace)
     flow2 = np.asarray(fn(params, jnp.asarray(im1), jnp.asarray(im2)))
     t2 = time.time()
+    if args.trace:
+        jax.profiler.stop_trace()
+        print(f"wrote profiler trace to {args.trace}")
     del flow2
     print(f"flow {flow.shape}  compile+run {t1 - t0:.2f}s  steady {t2 - t1:.3f}s")
 

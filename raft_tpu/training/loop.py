@@ -26,7 +26,8 @@ from .step import Batch, make_train_step
 
 def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
           ckpt_dir: Optional[str] = None, resume: bool = True,
-          data_parallel: bool = True, log_fn=print) -> TrainState:
+          data_parallel: bool = True, log_fn=print,
+          trace_dir: Optional[str] = None) -> TrainState:
     """Run the training loop over ``batch_iter`` yielding numpy
     (im1, im2, flow, valid) batches; returns the final state."""
     tx = make_optimizer(tconfig)
@@ -56,6 +57,12 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
             start_step = int(state.step)
             log_fn(f"[train] resumed from {latest} at step {start_step}")
 
+    # profiler window: steps 5-8 relative to start (post-compile, steady
+    # state) — the jax.profiler replacement for the reference's tf.profiler
+    # (reference infer_raft.py:88-92, which crashed before printing)
+    trace_window = (start_step + 5, start_step + 8) if trace_dir else None
+    tracing = False
+
     rng = jax.random.PRNGKey(tconfig.seed + 1)
     t0 = time.time()
     seen = 0
@@ -63,6 +70,13 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
         step = int(state.step)
         if step >= tconfig.num_steps:
             break
+        if trace_window and not tracing and step == trace_window[0]:
+            jax.profiler.start_trace(trace_dir)
+            tracing = True
+        if tracing and step >= trace_window[1]:
+            jax.profiler.stop_trace()
+            tracing = False
+            log_fn(f"[train] wrote profiler trace to {trace_dir}")
         rng, sub = jax.random.split(rng)
         batch = Batch(*jax.tree.map(jnp.asarray, tuple(batch_np)))
         state, metrics = step_fn(state, batch, sub)
@@ -78,6 +92,9 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
             save_checkpoint(p, jax.device_get(state))
             log_fn(f"[train] saved {p}")
 
+    if tracing:
+        jax.profiler.stop_trace()
+        log_fn(f"[train] wrote profiler trace to {trace_dir}")
     if ckpt_dir:
         p = Path(ckpt_dir) / f"ckpt_{int(state.step)}.npz"
         save_checkpoint(p, jax.device_get(state))
@@ -109,5 +126,6 @@ def train_cli(args, config: RAFTConfig) -> int:
         batch_iter = PrefetchLoader(synthetic_batches(tconfig.batch_size, size))
 
     ckpt_dir = str(Path(args.out) / tconfig.ckpt_dir)
-    train(config, tconfig, batch_iter, ckpt_dir=ckpt_dir)
+    train(config, tconfig, batch_iter, ckpt_dir=ckpt_dir,
+          trace_dir=getattr(args, "trace", None))
     return 0
