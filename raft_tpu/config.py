@@ -36,6 +36,11 @@ class RAFTConfig:
     # uses the fused TPU kernel (the CUDA-extension equivalent the reference
     # never wrote, reference readme.md:12).
     corr_impl: str = "dense"
+    # Window-lookup formulation for the dense impl: 'gather'
+    # (take_along_axis, the reference's SampleCorr semantics) or 'onehot'
+    # (separable one-hot interpolation matmuls — MXU work instead of
+    # gathers, typically faster on TPU).
+    corr_lookup: str = "gather"
     # MXU precision of the fused kernel's correlation matmul ('highest' =
     # true-f32 multi-pass, honoring the fp32-corr policy; 'default' = bf16
     # MXU inputs, matching the dense/blockwise einsum default and ~1.6x

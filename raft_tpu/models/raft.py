@@ -28,7 +28,8 @@ import jax.numpy as jnp
 from ..config import RAFTConfig
 from ..ops import spmd
 from ..ops.coords import coords_grid, upflow8
-from ..ops.corr import build_pyramid, fmap2_pyramid, lookup_dense, lookup_ondemand
+from ..ops.corr import (build_pyramid, fmap2_pyramid, lookup_dense,
+                        lookup_dense_onehot, lookup_ondemand)
 from ..ops.upsample import convex_upsample_flow
 from .encoders import apply_encoder, init_encoder
 from .update import (apply_basic_update_block, apply_small_update_block,
@@ -124,7 +125,9 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                                         spmd.spatial_axis())
     elif config.corr_impl == "dense":
         pyramid = build_pyramid(fmap1c, fmap2c, config.corr_levels)
-        lookup = functools.partial(lookup_dense, pyramid, radius=config.corr_radius)
+        lookup_fn = (lookup_dense_onehot if config.corr_lookup == "onehot"
+                     else lookup_dense)
+        lookup = functools.partial(lookup_fn, pyramid, radius=config.corr_radius)
     elif config.corr_impl == "blockwise":
         f2_levels = fmap2_pyramid(fmap2c, config.corr_levels)
         lookup = functools.partial(lookup_ondemand, fmap1c, f2_levels,
