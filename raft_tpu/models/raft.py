@@ -124,9 +124,12 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                                         config.corr_radius,
                                         spmd.spatial_axis())
     elif config.corr_impl == "dense":
-        pyramid = build_pyramid(fmap1c, fmap2c, config.corr_levels)
+        if config.corr_lookup not in ("gather", "onehot"):
+            raise ValueError(f"corr_lookup must be 'gather' or 'onehot', "
+                             f"got {config.corr_lookup!r}")
         lookup_fn = (lookup_dense_onehot if config.corr_lookup == "onehot"
                      else lookup_dense)
+        pyramid = build_pyramid(fmap1c, fmap2c, config.corr_levels)
         lookup = functools.partial(lookup_fn, pyramid, radius=config.corr_radius)
     elif config.corr_impl == "blockwise":
         f2_levels = fmap2_pyramid(fmap2c, config.corr_levels)

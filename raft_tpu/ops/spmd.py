@@ -53,6 +53,15 @@ def halo_exchange(x: jax.Array, halo: int, axis_name: Optional[str] = None) -> j
     axis_name = _axis if axis_name is None else axis_name
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
+    Hl = x.shape[1]
+    if halo > Hl:
+        # halo wider than the slab (tiny maps): neighbor exchange can't
+        # supply enough rows, so gather the full H axis and cut the padded
+        # window — correct and cheap exactly when maps are tiny.
+        full = jax.lax.all_gather(x, axis_name, axis=1, tiled=True)
+        full = jnp.pad(full, ((0, 0), (halo, halo), (0, 0), (0, 0)))
+        return jax.lax.dynamic_slice_in_dim(full, idx * Hl, Hl + 2 * halo,
+                                            axis=1)
     top = x[:, :halo]          # my top rows -> previous device's bottom halo
     bot = x[:, -halo:]         # my bottom rows -> next device's top halo
     # from next device: its top rows become my bottom halo

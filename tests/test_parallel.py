@@ -192,3 +192,23 @@ def test_shard_inference_matches_single_device(small):
     got = fn(params, im1, im2)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-2, rtol=1e-3)
+
+
+def test_shard_inference_halo_wider_than_slab():
+    """Tiny slabs (2 rows at 1/8 res) force the 7x7 conv's halo (3) past the
+    neighbor exchange — the all_gather fallback must keep exact parity."""
+    import dataclasses
+
+    from raft_tpu.parallel import make_shard_inference_fn
+
+    config = dataclasses.replace(RAFTConfig.full(iters=2), corr_levels=2)
+    params = init_raft(jax.random.PRNGKey(1), config)
+    rng = np.random.RandomState(6)
+    im1 = jnp.asarray(rng.rand(1, 128, 32, 3), jnp.float32)  # 8*8dev*2^1
+    im2 = jnp.asarray(rng.rand(1, 128, 32, 3), jnp.float32)
+    want = jax.jit(make_inference_fn(config))(params, im1, im2)
+
+    mesh = make_mesh(axes=(SPATIAL_AXIS,))
+    got = make_shard_inference_fn(config, mesh)(params, im1, im2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-2, rtol=1e-3)
