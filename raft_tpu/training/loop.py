@@ -8,6 +8,7 @@ save/resume; scalar logging.
 
 from __future__ import annotations
 
+import json
 import time
 from pathlib import Path
 from typing import Iterable, Optional
@@ -63,6 +64,14 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
     trace_window = (start_step + 5, start_step + 8) if trace_dir else None
     tracing = False
 
+    # scalar metrics stream: one JSON object per logged step, appended to
+    # <ckpt_dir>/metrics.jsonl (the durable-observability replacement for
+    # the reference's never-used add_moving_summary import, reference
+    # RAFT.py:6 / SURVEY.md §5)
+    metrics_path = Path(ckpt_dir) / "metrics.jsonl" if ckpt_dir else None
+    if metrics_path:
+        metrics_path.parent.mkdir(parents=True, exist_ok=True)
+
     rng = jax.random.PRNGKey(tconfig.seed + 1)
     t0 = time.time()
     seen = 0
@@ -87,6 +96,12 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
             log_fn(f"[train] step {step}  loss {float(m['loss']):.4f}  "
                    f"epe {float(m['epe']):.3f}  1px {float(m['1px']):.3f}  "
                    f"gnorm {float(m['grad_norm']):.2f}  {rate:.2f} it/s")
+            if metrics_path:
+                rec = {"step": step, "it_per_s": round(rate, 4),
+                       "wall_s": round(time.time() - t0, 2)}
+                rec.update({k: float(v) for k, v in m.items()})
+                with open(metrics_path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
         if ckpt_dir and (step + 1) % tconfig.ckpt_every == 0:
             p = Path(ckpt_dir) / f"ckpt_{step + 1}.npz"
             save_checkpoint(p, jax.device_get(state))

@@ -149,3 +149,35 @@ def test_trained_step_improves_epe_vs_init():
     for _ in range(30):
         state, m = step(state, batch, rng)
     assert float(m["epe"]) < float(m0["epe"]), (float(m0["epe"]), float(m["epe"]))
+
+
+def test_train_crash_resume_end_to_end(tmp_path):
+    """Failure-recovery drill: train 6 steps with periodic checkpoints,
+    'crash', then call train() again — it must resume from the latest
+    checkpoint (not step 0), finish the remaining steps, and stream scalar
+    metrics to metrics.jsonl."""
+    import json
+
+    from raft_tpu.data.pipeline import synthetic_batches
+    from raft_tpu.training.loop import train
+
+    config = RAFTConfig.small_model(iters=2)
+    ckpt = tmp_path / "ckpts"
+    logs = []
+
+    def run(num_steps):
+        tconfig = TrainConfig(num_steps=num_steps, batch_size=2, lr=1e-4,
+                              schedule="constant", ckpt_every=3, log_every=2,
+                              image_size=(32, 48))
+        return train(config, tconfig, synthetic_batches(2, (32, 48)),
+                     ckpt_dir=str(ckpt), data_parallel=False,
+                     log_fn=logs.append)
+
+    state = run(6)
+    assert int(state.step) == 6
+    state = run(10)
+    assert int(state.step) == 10
+    assert any("resumed" in line and "at step 6" in line for line in logs)
+    records = [json.loads(l) for l in (ckpt / "metrics.jsonl").read_text().splitlines()]
+    assert records[0]["step"] == 0 and records[-1]["step"] == 9
+    assert all(np.isfinite(r["loss"]) for r in records)
