@@ -101,18 +101,19 @@ def main() -> int:
     # still measures the likely winner; best one is the headline number
     candidates = ([args.impl] if args.impl
                   else ["pallas-bf16corr", "pallas", "dense-onehot", "dense",
-                        "blockwise"])
+                        "blockwise-onehot", "blockwise"])
     if jax.default_backend() != "tpu" and not args.impl:
         # off-TPU the Pallas kernel runs in interpret mode (test-only speed)
         candidates = [c for c in candidates if not c.startswith("pallas")]
     def cfg_for(name: str):
         """Map a candidate name (bare, no '+bf16'/',bN' suffixes) to config."""
         impl = ("pallas" if name.startswith("pallas")
-                else "dense" if name.startswith("dense") else name)
+                else "dense" if name.startswith("dense")
+                else "blockwise" if name.startswith("blockwise") else name)
         return RAFTConfig.full(
             corr_impl=impl,
             corr_precision="default" if name == "pallas-bf16corr" else "highest",
-            corr_lookup="onehot" if name == "dense-onehot" else "gather",
+            corr_lookup="onehot" if name.endswith("-onehot") else "gather",
             compute_dtype="bfloat16")
 
     best_name, best = None, -1.0

@@ -28,8 +28,8 @@ import jax.numpy as jnp
 from ..config import RAFTConfig
 from ..ops import spmd
 from ..ops.coords import coords_grid, upflow8
-from ..ops.corr import (build_pyramid, fmap2_pyramid, lookup_dense,
-                        lookup_dense_onehot, lookup_ondemand)
+from ..ops.corr import (build_pyramid, fmap2_pyramid, lookup_blockwise_onehot,
+                        lookup_dense, lookup_dense_onehot, lookup_ondemand)
 from ..ops.upsample import convex_upsample_flow
 from .encoders import apply_encoder, init_encoder
 from .update import (apply_basic_update_block, apply_small_update_block,
@@ -133,8 +133,12 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
         lookup = functools.partial(lookup_fn, pyramid, radius=config.corr_radius)
     elif config.corr_impl == "blockwise":
         f2_levels = fmap2_pyramid(fmap2c, config.corr_levels)
-        lookup = functools.partial(lookup_ondemand, fmap1c, f2_levels,
-                                   radius=config.corr_radius)
+        if config.corr_lookup == "onehot":
+            lookup = functools.partial(lookup_blockwise_onehot, fmap1c,
+                                       f2_levels, radius=config.corr_radius)
+        else:
+            lookup = functools.partial(lookup_ondemand, fmap1c, f2_levels,
+                                       radius=config.corr_radius)
     elif config.corr_impl == "pallas":
         try:
             from ..ops.corr_pallas import make_fused_lookup

@@ -1,8 +1,8 @@
 from .conv import apply_conv, avg_pool2d, conv2d, init_conv
 from .coords import coords_grid, resize_bilinear_align_corners, upflow8
-from .corr import (build_pyramid, dense_corr, fmap2_pyramid, lookup_dense,
-                   lookup_dense_onehot, lookup_ondemand, lookup_partial_onehot,
-                   naive_corr_lookup)
+from .corr import (build_pyramid, dense_corr, fmap2_pyramid,
+                   lookup_blockwise_onehot, lookup_dense, lookup_dense_onehot,
+                   lookup_ondemand, lookup_partial_onehot, naive_corr_lookup)
 from .grid_sample import grid_sample, grid_sample_normalized
 from .norm import (batch_norm, group_norm, init_batch_norm, init_group_norm,
                    instance_norm)

@@ -249,6 +249,47 @@ def lookup_ondemand(fmap1: jax.Array, fmap2_levels: Sequence[jax.Array],
     return out.reshape(B, H, W, -1)
 
 
+def lookup_blockwise_onehot(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
+                            coords: jax.Array, radius: int,
+                            chunk: int = 512) -> jax.Array:
+    """Blockwise correlation lookup, matmul-only (no gathers, no (HW)^2
+    volume): per query chunk and level, one [T, P] correlation tile on the
+    MXU followed by the separable one-hot window lookup — the XLA twin of
+    the fused Pallas kernel (ops/corr_pallas.py), fully differentiable, so
+    it also serves as that kernel's backward delegate."""
+    B, H, W, C = fmap1.shape
+    Q = H * W
+    f1 = fmap1.reshape(B, Q, C)
+    flat = coords.reshape(B, Q, 2)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(C, jnp.float32))
+
+    pad = (-Q) % chunk
+    if pad:
+        f1 = jnp.pad(f1, ((0, 0), (0, pad), (0, 0)))
+        flat = jnp.pad(flat, ((0, 0), (0, pad), (0, 0)))
+    nchunks = (Q + pad) // chunk
+    f1 = f1.reshape(B, nchunks, chunk, C).transpose(1, 0, 2, 3)
+    flat = flat.reshape(B, nchunks, chunk, 2).transpose(1, 0, 2, 3)
+
+    def one_chunk(args):
+        f1_c, coords_c = args          # [B, T, C], [B, T, 2]
+        outs = []
+        for i, f2 in enumerate(f2_levels):
+            _, H2, W2, _ = f2.shape
+            corr = jnp.einsum("btc,bpc->btp", f1_c,
+                              f2.reshape(B, H2 * W2, C),
+                              preferred_element_type=jnp.float32) * scale
+            outs.append(lookup_partial_onehot(
+                corr.reshape(B, chunk, H2, W2), coords_c, radius, i))
+        return jnp.concatenate(outs, axis=-1)   # [B, T, L*n*n]
+
+    out = jax.lax.map(one_chunk, (f1, flat))    # [nchunks, B, T, L*n*n]
+    out = out.transpose(1, 0, 2, 3).reshape(B, Q + pad, -1)
+    if pad:
+        out = out[:, :Q]
+    return out.reshape(B, H, W, -1)
+
+
 def naive_corr_lookup(fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array,
                       num_levels: int, radius: int) -> jax.Array:
     """Straightforward per-point implementation mirroring the reference's

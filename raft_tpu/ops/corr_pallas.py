@@ -21,10 +21,11 @@ Design (flash-attention-style, MXU-first):
   construction for free — an out-of-range index simply never matches — and
   partial windows straddling a P-block boundary accumulate across the k grid
   dimension. No per-query scalar loop, no gathers.
-* Backward delegates to the differentiable XLA blockwise implementation
-  (``ops.corr.lookup_ondemand``) via ``custom_vjp``: the forward rides the
-  kernel, gradients ride XLA fusions. (``coords`` is ``stop_gradient``'d
-  upstream anyway — models/raft.py step(), mirroring reference RAFT.py:93.)
+* Backward delegates to the differentiable, matmul-only XLA twin
+  (``ops.corr.lookup_blockwise_onehot``) via ``custom_vjp``: the forward
+  rides the kernel, gradients ride XLA matmul fusions with no gathers.
+  (``coords`` is ``stop_gradient``'d upstream anyway — models/raft.py
+  step(), mirroring reference RAFT.py:93.)
 
 Numerics: everything float32 (the bf16-with-fp32-corr policy; outputs match
 ``ops.corr.lookup_dense`` to float32 round-off). Off-TPU backends run the
@@ -40,7 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from .corr import fmap2_pyramid, lookup_ondemand
+from .corr import fmap2_pyramid, lookup_blockwise_onehot
 
 
 def _round_up(x: int, m: int) -> int:
@@ -206,9 +207,10 @@ def _fused_lookup_fwd(fmap1, f2_levels, coords, radius, corr_precision):
 
 
 def _fused_lookup_bwd(radius, corr_precision, residuals, g):
+    # gradients via the matmul-only XLA twin (no gathers in the backward)
     fmap1, f2_levels, coords = residuals
     _, vjp = jax.vjp(
-        lambda a, b, c: lookup_ondemand(a, list(b), c, radius),
+        lambda a, b, c: lookup_blockwise_onehot(a, tuple(b), c, radius),
         fmap1, tuple(f2_levels), coords)
     return vjp(g)
 

@@ -5,6 +5,7 @@ oracle that mirrors the reference's SampleCorr semantics
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from raft_tpu.ops import (build_pyramid, dense_corr, fmap2_pyramid,
@@ -75,3 +76,40 @@ def test_channel_ordering_x_major():
     # peak for query (4,4) now at x-offset -1 => window index (r-1, r)
     val = out_shift[0, 4, 4, :].reshape(n, n)[r - 1, r]
     np.testing.assert_allclose(float(val), expect, rtol=1e-5)
+
+
+def test_blockwise_onehot_matches_dense():
+    from raft_tpu.ops.corr import (build_pyramid, fmap2_pyramid,
+                                   lookup_blockwise_onehot, lookup_dense)
+    rng = np.random.RandomState(7)
+    B, H, W, C, L, r = 2, 10, 14, 16, 3, 3
+    f1 = jnp.asarray(rng.randn(B, H, W, C), jnp.float32)
+    f2 = jnp.asarray(rng.randn(B, H, W, C), jnp.float32)
+    coords = jnp.asarray(rng.uniform(-5, 18, (B, H, W, 2)), jnp.float32)
+    want = lookup_dense(build_pyramid(f1, f2, L), coords, r)
+    got = lookup_blockwise_onehot(f1, fmap2_pyramid(f2, L), coords, r,
+                                  chunk=32)   # forces the pad/chunk path
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_blockwise_onehot_grads_match_ondemand():
+    from raft_tpu.ops.corr import (fmap2_pyramid, lookup_blockwise_onehot,
+                                   lookup_ondemand)
+    rng = np.random.RandomState(8)
+    B, H, W, C, L, r = 1, 8, 10, 8, 2, 2
+    f1 = jnp.asarray(rng.randn(B, H, W, C), jnp.float32)
+    f2 = jnp.asarray(rng.randn(B, H, W, C), jnp.float32)
+    coords = jnp.asarray(rng.uniform(-2, 12, (B, H, W, 2)), jnp.float32)
+    f2l = tuple(fmap2_pyramid(f2, L))
+    cot = jnp.asarray(rng.randn(B, H, W, L * (2 * r + 1) ** 2), jnp.float32)
+
+    g_a = jax.grad(lambda a, b, c: jnp.sum(
+        lookup_blockwise_onehot(a, b, c, r) * cot), argnums=(0, 1, 2))(
+        f1, f2l, coords)
+    g_b = jax.grad(lambda a, b, c: jnp.sum(
+        lookup_ondemand(a, list(b), c, r) * cot), argnums=(0, 1, 2))(
+        f1, f2l, coords)
+    for x, y in zip(jax.tree.leaves(g_a), jax.tree.leaves(g_b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-4, atol=1e-4)
