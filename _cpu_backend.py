@@ -1,0 +1,33 @@
+"""The one copy of the force-CPU-backend recipe.
+
+The environment pins JAX_PLATFORMS=axon (the TPU tunnel) and re-sets the env
+var at interpreter startup, so the var alone cannot select CPU — the platform
+must be overridden via jax.config after import, before any backend
+initialization.  Virtual-device count for multi-device-on-CPU testing rides
+XLA_FLAGS, which the CPU client reads lazily at backend creation.
+
+Used by tests/conftest.py, __graft_entry__.dryrun_multichip, and bench.py;
+MULTICHIP_r01 (rc=124) is what happens when an entry point misses a step of
+this recipe.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_backend(n_devices: int | None = None):
+    """Force the CPU backend; optionally request ``n_devices`` virtual
+    devices.  Must run before any jax backend initialization (first device
+    query / computation).  Returns the configured jax module."""
+    if n_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return jax

@@ -1,17 +1,15 @@
 """Test configuration: run everything on CPU with 8 virtual devices so the
 multi-device sharding paths are exercised without TPU hardware (SURVEY.md §4).
 
-Note: the environment pins JAX_PLATFORMS=axon (the TPU tunnel) and re-sets it
-at interpreter startup, so the env var alone is not enough — we must override
-via jax.config after import, before any backend initialization.
+The force-CPU recipe lives in _cpu_backend.py at the repo root (shared with
+__graft_entry__.dryrun_multichip and bench.py).
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
+from _cpu_backend import force_cpu_backend
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_backend(8)
