@@ -22,10 +22,11 @@ def force_cpu_backend(n_devices: int | None = None):
     query / computation).  Returns the configured jax module."""
     if n_devices:
         flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={n_devices}"
-            ).strip()
+        # replace (not skip) any existing count so the caller's request wins
+        kept = [f for f in flags.split()
+                if "xla_force_host_platform_device_count" not in f]
+        kept.append(f"--xla_force_host_platform_device_count={n_devices}")
+        os.environ["XLA_FLAGS"] = " ".join(kept)
 
     import jax
 

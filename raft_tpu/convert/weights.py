@@ -72,6 +72,7 @@ def from_torch_state_dict(state_dict: Mapping[str, np.ndarray],
     if skipped and strict:
         raise ValueError(f"unrecognized state_dict entries: {skipped}")
     _fix_biases(params)
+    _drop_aliased_norms(params)
     if swap_input_channels:
         swap_rgb_bgr(params)
     return params
@@ -84,6 +85,31 @@ def _fix_biases(node: dict) -> None:
     for v in node.values():
         if isinstance(v, dict):
             _fix_biases(v)
+
+
+def _drop_aliased_norms(node: dict) -> None:
+    """Official checkpoints register the strided-block shortcut norm twice:
+    as an attribute (``norm3`` on ResidualBlock, ``norm4`` on
+    BottleneckBlock) AND inside the downsample Sequential (``downsample.1``)
+    — the same tensors under two names.  Keep the canonical ``downsample.1``
+    copy; drop the attribute alias after checking the two agree (a mismatch
+    would mean the checkpoint is not official-RAFT shaped)."""
+    ds = node.get("downsample")
+    if isinstance(ds, dict) and isinstance(ds.get("1"), dict):
+        alias = "norm4" if "conv3" in node else "norm3"
+        dup = node.get(alias)
+        if isinstance(dup, dict):
+            canon = ds["1"]
+            for k, v in dup.items():
+                if k not in canon or not np.array_equal(np.asarray(v),
+                                                        np.asarray(canon[k])):
+                    raise ValueError(
+                        f"shortcut-norm alias '{alias}' disagrees with "
+                        f"downsample.1 on leaf {k!r}")
+            del node[alias]
+    for v in node.values():
+        if isinstance(v, dict):
+            _drop_aliased_norms(v)
 
 
 def swap_rgb_bgr(params: Dict[str, dict]) -> None:

@@ -1,0 +1,99 @@
+"""Full-model torch-golden parity: official RAFT (torch oracle, eval mode)
+vs raft-tpu, driven by weights converted with ``from_torch_state_dict`` from
+a REAL official-architecture state_dict (not a round-trip of our own export).
+
+This is the honest substitute for trained-weights validation in this
+environment: any divergence in channel plan, parameter naming, padding, norm
+semantics, correlation window ordering, or upsampling breaks it.  The
+reference repo never closed this parity gap (reference readme.md:45 — "a few
+of differences from the official implementation"); raft-tpu must.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.convert import assert_tree_shapes_match, from_torch_state_dict
+from raft_tpu.models import init_raft, raft_forward
+
+from torch_raft_golden import RAFT as TorchRAFT
+
+
+def _run_pair(small: bool, B, H, W, iters, corr_impl="dense",
+              corr_lookup="gather"):
+    torch.manual_seed(0)
+    tmodel = TorchRAFT(small=small).eval()
+    # non-trivial BN running stats so eval-mode normalization is exercised
+    with torch.no_grad():
+        for m in tmodel.modules():
+            if isinstance(m, torch.nn.BatchNorm2d):
+                m.running_mean.uniform_(-0.05, 0.05)
+                m.running_var.uniform_(0.9, 1.1)
+
+    sd = {k: v.detach().numpy() for k, v in tmodel.state_dict().items()}
+    params = from_torch_state_dict(sd)
+
+    cfg = (RAFTConfig.small_model if small else RAFTConfig.full)(
+        iters=iters, corr_impl=corr_impl, corr_lookup=corr_lookup,
+        compute_dtype="float32")
+    expected = init_raft(jax.random.PRNGKey(0), cfg)
+    assert_tree_shapes_match(params, expected)
+    params = jax.tree.map(jnp.asarray, params)
+
+    rng = np.random.RandomState(7)
+    im = rng.rand(2, B, H, W, 3).astype(np.float32)   # [0, 1]
+
+    with torch.no_grad():
+        tflows = tmodel(
+            torch.from_numpy(255.0 * im[0].transpose(0, 3, 1, 2)),
+            torch.from_numpy(255.0 * im[1].transpose(0, 3, 1, 2)),
+            iters=iters)
+    tflows = np.stack([f.numpy().transpose(0, 2, 3, 1) for f in tflows])
+
+    out, _ = raft_forward(params, jnp.asarray(im[0]), jnp.asarray(im[1]),
+                          cfg, train=False, all_flows=True)
+    jflows = np.asarray(out.flow_iters)
+    return tflows, jflows
+
+
+@pytest.mark.parametrize("small", [False, True], ids=["full", "small"])
+def test_full_model_torch_parity(small):
+    tflows, jflows = _run_pair(small, B=1, H=128, W=128, iters=3)
+    assert tflows.shape == jflows.shape
+    for i, (tf_i, jf_i) in enumerate(zip(tflows, jflows)):
+        err = np.abs(tf_i - jf_i).max()
+        scale = np.abs(tf_i).max()
+        assert err <= 1e-3 + 1e-3 * scale, (
+            f"iter {i}: max|Δflow|={err:.2e} vs scale {scale:.2e}")
+
+
+def test_full_model_torch_parity_blockwise_onehot():
+    """The tuned lookup paths must match the official model too, not just
+    the dense/gather correctness reference."""
+    tflows, jflows = _run_pair(False, B=1, H=128, W=128, iters=2,
+                               corr_impl="blockwise", corr_lookup="onehot")
+    err = np.abs(tflows[-1] - jflows[-1]).max()
+    scale = np.abs(tflows[-1]).max()
+    assert err <= 1e-3 + 1e-3 * scale, (err, scale)
+
+
+def test_official_state_dict_shape_contract():
+    """The official checkpoints carry DataParallel 'module.' prefixes,
+    num_batches_tracked counters, and aliased shortcut norms — the converter
+    must digest all of that from a REAL official-architecture state_dict."""
+    torch.manual_seed(1)
+    tmodel = TorchRAFT(small=False).eval()
+    sd = {f"module.{k}": v.detach().numpy()
+          for k, v in tmodel.state_dict().items()}
+    # the aliasing quirk really is present in the architecture
+    assert "module.cnet.layer2.0.norm3.weight" in sd
+    assert "module.cnet.layer2.0.downsample.1.weight" in sd
+    assert any(k.endswith("num_batches_tracked") for k in sd)
+
+    params = from_torch_state_dict(sd)
+    expected = init_raft(jax.random.PRNGKey(0), RAFTConfig.full())
+    assert_tree_shapes_match(params, expected)
