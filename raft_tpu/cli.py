@@ -69,8 +69,7 @@ def _build_parser() -> argparse.ArgumentParser:
 
 def _make_config(args):
     from .config import RAFTConfig
-    overrides = dict(corr_impl=args.corr_impl, compute_dtype=args.dtype,
-                     channel_order="rgb" if args.rgb else "bgr")
+    overrides = dict(corr_impl=args.corr_impl, compute_dtype=args.dtype)
     if args.iters is not None:
         overrides["iters"] = args.iters
     if args.small:
@@ -86,7 +85,7 @@ def _load_params(args, config):
         from .convert.weights import detect_format
         import jax.numpy as jnp
         params = load_checkpoint_auto(args.load)
-        if config.channel_order == "bgr" and detect_format(args.load) == "torch":
+        if not args.rgb and detect_format(args.load) == "torch":
             # official torch checkpoints are RGB-trained; inputs arrive BGR
             from .convert import swap_rgb_bgr
             swap_rgb_bgr(params)

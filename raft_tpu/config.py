@@ -27,9 +27,10 @@ class RAFTConfig:
     corr_radius: int = 4
     iters: int = 32
     dropout: float = 0.0
-    # 'bgr' matches the reference's cv2 input path (reference RAFT.py:13,
-    # dataflow/test_dataflow.py:56); 'rgb' matches the official weights.
-    channel_order: str = "bgr"
+    # NOTE: input channel order (BGR per the reference's cv2 path, reference
+    # RAFT.py:13, vs RGB for the official weights) is a property of the DATA
+    # and the loaded WEIGHTS, not of the model graph — it lives in the CLI
+    # (--rgb) and the weight converter (swap_input_channels), not here.
     # Correlation implementation: 'dense' materializes per-level volumes
     # (reference model_utils.py:199-221 semantics), 'blockwise' chunks over
     # query pixels and never materializes the full (HW)^2 volume, 'pallas'

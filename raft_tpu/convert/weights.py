@@ -13,7 +13,7 @@ path segments, so conversion is a pure leaf-name + layout map:
 Channel order: the official weights were trained on RGB input; the reference
 feeds BGR (reference RAFT.py:13).  ``swap_input_channels=True`` permutes the
 first conv's input channels of fnet and cnet so the converted model accepts
-BGR directly (what RAFTConfig.channel_order='bgr' expects).
+BGR directly (the CLI does this for torch checkpoints unless --rgb is given).
 """
 
 from __future__ import annotations

@@ -22,11 +22,21 @@ _PNG_MAGIC = b"\x89PNG"
 _JPEG_MAGIC = b"\xff\xd8"
 
 
+def _native_decodable(data: bytes) -> bool:
+    """JPEGs and 8-bit PNGs only: libpng's simplified API depth-converts
+    16-bit PNGs with different rounding than cv2.imdecode, so those route to
+    cv2 for decoder-independent pixels.  PNG bit depth is byte 24 (after the
+    8-byte signature and the IHDR length/type/width/height)."""
+    if data.startswith(_JPEG_MAGIC):
+        return True
+    return (data.startswith(_PNG_MAGIC) and len(data) > 24 and data[24] == 8)
+
+
 def _read_image(path) -> np.ndarray:
     from .. import native
     with open(path, "rb") as f:             # BGR, reference convention
         data = f.read()
-    if data.startswith((_PNG_MAGIC, _JPEG_MAGIC)) and native.available():
+    if _native_decodable(data) and native.available():
         try:
             return native.decode_image(data)
         except ValueError:

@@ -13,8 +13,9 @@ Re-design of reference networks/RAFT.py:78-134 (``network_graph``):
 * correlation can run dense, blockwise (on-demand), or via the fused Pallas
   kernel (config.corr_impl).
 
-Inputs are float images in [0, 1], NHWC, channel order per config
-(reference preprocessing: RAFT.py:53-59, BGR note at RAFT.py:13).
+Inputs are float images in [0, 1], NHWC; channel order must match the loaded
+weights (reference preprocessing: RAFT.py:53-59, BGR note at RAFT.py:13; the
+CLI and converter handle the RGB/BGR stem swap).
 """
 
 from __future__ import annotations
@@ -116,29 +117,43 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
     fmap1c = fmap1.astype(jnp.float32)
     fmap2c = fmap2.astype(jnp.float32)
 
+    if config.corr_lookup not in ("gather", "onehot"):
+        # validated for every impl, not just dense — a typo must not fall
+        # back silently to the gather path
+        raise ValueError(f"corr_lookup must be 'gather' or 'onehot', "
+                         f"got {config.corr_lookup!r}")
+    if config.corr_precision not in ("highest", "default"):
+        # same silent-fallback hazard as corr_lookup: a typo must not
+        # quietly degrade the corr matmuls to bf16 MXU inputs
+        raise ValueError(f"corr_precision must be 'highest' or 'default', "
+                         f"got {config.corr_precision!r}")
+    corr_prec = (jax.lax.Precision.HIGHEST if config.corr_precision == "highest"
+                 else jax.lax.Precision.DEFAULT)
+
     if spmd.spatial_axis() is not None:
         # row-sharded run (make_shard_inference_fn): correlation must see the
         # full fmap2, which lives sharded across devices -> ring pass
         from ..parallel.spatial import make_ring_lookup_local
         lookup = make_ring_lookup_local(fmap1c, fmap2c, config.corr_levels,
                                         config.corr_radius,
-                                        spmd.spatial_axis())
+                                        spmd.spatial_axis(),
+                                        precision=corr_prec)
     elif config.corr_impl == "dense":
-        if config.corr_lookup not in ("gather", "onehot"):
-            raise ValueError(f"corr_lookup must be 'gather' or 'onehot', "
-                             f"got {config.corr_lookup!r}")
         lookup_fn = (lookup_dense_onehot if config.corr_lookup == "onehot"
                      else lookup_dense)
-        pyramid = build_pyramid(fmap1c, fmap2c, config.corr_levels)
+        pyramid = build_pyramid(fmap1c, fmap2c, config.corr_levels,
+                                precision=corr_prec)
         lookup = functools.partial(lookup_fn, pyramid, radius=config.corr_radius)
     elif config.corr_impl == "blockwise":
         f2_levels = fmap2_pyramid(fmap2c, config.corr_levels)
         if config.corr_lookup == "onehot":
             lookup = functools.partial(lookup_blockwise_onehot, fmap1c,
-                                       f2_levels, radius=config.corr_radius)
+                                       f2_levels, radius=config.corr_radius,
+                                       precision=corr_prec)
         else:
             lookup = functools.partial(lookup_ondemand, fmap1c, f2_levels,
-                                       radius=config.corr_radius)
+                                       radius=config.corr_radius,
+                                       precision=corr_prec)
     elif config.corr_impl == "pallas":
         try:
             from ..ops.corr_pallas import make_fused_lookup
@@ -148,7 +163,7 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                 "TPU kernel); use 'dense' or 'blockwise'.") from e
         lookup = make_fused_lookup(fmap1c, fmap2c, config.corr_levels,
                                    config.corr_radius,
-                                   corr_precision=config.corr_precision)
+                                   corr_precision=corr_prec)
     else:
         raise ValueError(config.corr_impl)
 

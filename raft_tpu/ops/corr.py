@@ -44,21 +44,24 @@ def fmap2_pyramid(fmap2: jax.Array, num_levels: int = 4) -> List[jax.Array]:
     return levels
 
 
-def dense_corr(fmap1: jax.Array, fmap2_l: jax.Array) -> jax.Array:
+def dense_corr(fmap1: jax.Array, fmap2_l: jax.Array,
+               precision=None) -> jax.Array:
     """[B, H1, W1, C] x [B, H2, W2, C] -> [B, H1*W1, H2, W2] scaled corr."""
     B, H1, W1, C = fmap1.shape
     _, H2, W2, _ = fmap2_l.shape
     f1 = fmap1.reshape(B, H1 * W1, C)
     f2 = fmap2_l.reshape(B, H2 * W2, C)
-    corr = jnp.einsum("bqc,bpc->bqp", f1, f2,
+    corr = jnp.einsum("bqc,bpc->bqp", f1, f2, precision=precision,
                       preferred_element_type=jnp.float32)
     corr = corr / jnp.sqrt(jnp.asarray(C, jnp.float32))
     return corr.reshape(B, H1 * W1, H2, W2)
 
 
-def build_pyramid(fmap1: jax.Array, fmap2: jax.Array, num_levels: int = 4) -> List[jax.Array]:
+def build_pyramid(fmap1: jax.Array, fmap2: jax.Array, num_levels: int = 4,
+                  precision=None) -> List[jax.Array]:
     """Dense correlation pyramid: list of [B, Q, H2/2^i, W2/2^i]."""
-    return [dense_corr(fmap1, f2) for f2 in fmap2_pyramid(fmap2, num_levels)]
+    return [dense_corr(fmap1, f2, precision=precision)
+            for f2 in fmap2_pyramid(fmap2, num_levels)]
 
 
 def _window_gather_2d(vol: jax.Array, ix0: jax.Array, iy0: jax.Array, win: int) -> jax.Array:
@@ -203,7 +206,8 @@ def _gather_feature_windows(fmap: jax.Array, ix0: jax.Array, iy0: jax.Array, win
 
 
 def lookup_ondemand(fmap1: jax.Array, fmap2_levels: Sequence[jax.Array],
-                    coords: jax.Array, radius: int, chunk: int = 1024) -> jax.Array:
+                    coords: jax.Array, radius: int, chunk: int = 1024,
+                    precision=None) -> jax.Array:
     """Blockwise correlation lookup without any (HW)^2 volume.
 
     For each query chunk and level: gather the (2r+2)^2 fmap2 feature window,
@@ -237,7 +241,7 @@ def lookup_ondemand(fmap1: jax.Array, fmap2_levels: Sequence[jax.Array],
             ix0 = cx0.astype(jnp.int32) - radius
             iy0 = cy0.astype(jnp.int32) - radius
             winf = _gather_feature_windows(f2, ix0, iy0, win)      # [B,T,win,win,C]
-            winv = jnp.einsum("btyxc,btc->btyx", winf, f1_c,
+            winv = jnp.einsum("btyxc,btc->btyx", winf, f1_c, precision=precision,
                               preferred_element_type=jnp.float32) * scale
             outs.append(_bilinear_window(winv, cx - cx0, cy - cy0, radius))
         return jnp.concatenate(outs, axis=-1)      # [B, T, L*n*n]
@@ -251,7 +255,7 @@ def lookup_ondemand(fmap1: jax.Array, fmap2_levels: Sequence[jax.Array],
 
 def lookup_blockwise_onehot(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
                             coords: jax.Array, radius: int,
-                            chunk: int = 512) -> jax.Array:
+                            chunk: int = 512, precision=None) -> jax.Array:
     """Blockwise correlation lookup, matmul-only (no gathers, no (HW)^2
     volume): per query chunk and level, one [T, P] correlation tile on the
     MXU followed by the separable one-hot window lookup — the XLA twin of
@@ -277,7 +281,7 @@ def lookup_blockwise_onehot(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
         for i, f2 in enumerate(f2_levels):
             _, H2, W2, _ = f2.shape
             corr = jnp.einsum("btc,bpc->btp", f1_c,
-                              f2.reshape(B, H2 * W2, C),
+                              f2.reshape(B, H2 * W2, C), precision=precision,
                               preferred_element_type=jnp.float32) * scale
             outs.append(lookup_partial_onehot(
                 corr.reshape(B, chunk, H2, W2), coords_c, radius, i))

@@ -207,10 +207,13 @@ def _fused_lookup_fwd(fmap1, f2_levels, coords, radius, corr_precision):
 
 
 def _fused_lookup_bwd(radius, corr_precision, residuals, g):
-    # gradients via the matmul-only XLA twin (no gathers in the backward)
+    # gradients via the matmul-only XLA twin (no gathers in the backward);
+    # the configured corr precision applies to the backward matmuls too —
+    # 'highest' must not silently degrade to bf16 MXU inputs in training
     fmap1, f2_levels, coords = residuals
     _, vjp = jax.vjp(
-        lambda a, b, c: lookup_blockwise_onehot(a, tuple(b), c, radius),
+        lambda a, b, c: lookup_blockwise_onehot(a, tuple(b), c, radius,
+                                                precision=corr_precision),
         fmap1, tuple(f2_levels), coords)
     return vjp(g)
 
@@ -219,7 +222,7 @@ fused_lookup.defvjp(_fused_lookup_fwd, _fused_lookup_bwd)
 
 
 def make_fused_lookup(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
-                      radius: int, corr_precision: str = "highest"):
+                      radius: int, corr_precision="highest"):
     """Build the per-iteration lookup closure used by models/raft.py.
 
     Pools the fmap2 pyramid once; each GRU iteration then runs the fused
@@ -229,8 +232,11 @@ def make_fused_lookup(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
     """
     f2_levels = tuple(fmap2_pyramid(fmap2.astype(jnp.float32), num_levels))
     fmap1 = fmap1.astype(jnp.float32)
-    prec = (jax.lax.Precision.HIGHEST if corr_precision == "highest"
-            else jax.lax.Precision.DEFAULT)
+    if isinstance(corr_precision, jax.lax.Precision):
+        prec = corr_precision
+    else:
+        prec = (jax.lax.Precision.HIGHEST if corr_precision == "highest"
+                else jax.lax.Precision.DEFAULT)
 
     def lookup(coords: jax.Array) -> jax.Array:
         return fused_lookup(fmap1, f2_levels, coords, radius, prec)

@@ -67,7 +67,8 @@ def make_spatial_corr_lookup(mesh: Mesh, num_levels: int, radius: int,
 
 
 def make_ring_lookup_local(f1_local: jax.Array, f2_local: jax.Array,
-                           num_levels: int, radius: int, axis: str):
+                           num_levels: int, radius: int, axis: str,
+                           precision=None):
     """Build a per-iteration ring-pass correlation lookup closure for use
     INSIDE an existing shard_map over ``axis`` (fmap1/fmap2/coords all
     row-sharded slabs, coords in global pixel units).
@@ -99,8 +100,8 @@ def make_ring_lookup_local(f1_local: jax.Array, f2_local: jax.Array,
             for i, f2l in enumerate(levels):
                 H2l = f2l.shape[1]
                 outs.append(lookup_partial_onehot(
-                    dense_corr(f1_local, f2l), flat, radius, i,
-                    row_offset=src * H2l))
+                    dense_corr(f1_local, f2l, precision=precision), flat,
+                    radius, i, row_offset=src * H2l))
             return jnp.concatenate(outs, axis=-1)
 
         def step(carry, _):

@@ -58,10 +58,11 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
             start_step = int(state.step)
             log_fn(f"[train] resumed from {latest} at step {start_step}")
 
-    # profiler window: steps 5-8 relative to start (post-compile, steady
-    # state) — the jax.profiler replacement for the reference's tf.profiler
+    # profiler window: steps 5-8 inclusive relative to start (post-compile,
+    # steady state; stop fires when step reaches the exclusive end) — the
+    # jax.profiler replacement for the reference's tf.profiler
     # (reference infer_raft.py:88-92, which crashed before printing)
-    trace_window = (start_step + 5, start_step + 8) if trace_dir else None
+    trace_window = (start_step + 5, start_step + 9) if trace_dir else None
     tracing = False
 
     # scalar metrics stream: one JSON object per logged step, appended to
@@ -71,6 +72,24 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
     metrics_path = Path(ckpt_dir) / "metrics.jsonl" if ckpt_dir else None
     if metrics_path:
         metrics_path.parent.mkdir(parents=True, exist_ok=True)
+        if start_step and metrics_path.exists():
+            # a crash between a logged step and the next checkpoint leaves
+            # records past the restored step; drop them so the stream stays
+            # one record per step across resumes
+            lines = [ln for ln in metrics_path.read_text().splitlines()
+                     if ln.strip()]
+
+            def _keep(ln: str) -> bool:
+                try:
+                    return json.loads(ln).get("step", -1) < start_step
+                except json.JSONDecodeError:
+                    return False   # partial line from the crash mid-append
+
+            kept = [ln for ln in lines if _keep(ln)]
+            if len(kept) != len(lines):
+                metrics_path.write_text("".join(ln + "\n" for ln in kept))
+                log_fn(f"[train] metrics.jsonl: dropped {len(lines) - len(kept)} "
+                       f"record(s) from steps >= {start_step} (replayed)")
 
     rng = jax.random.PRNGKey(tconfig.seed + 1)
     t0 = time.time()

@@ -145,3 +145,34 @@ def test_synthetic_batches():
     assert im1.shape == (2, 16, 24, 3)
     assert flow.shape == (2, 16, 24, 2)
     assert valid.all()
+
+
+def test_native_decode_routing_by_bit_depth(tmp_path):
+    """16-bit PNGs must route to cv2 (libpng's simplified API rounds the
+    8-bit conversion differently); 8-bit PNGs and JPEGs may go native."""
+    import cv2
+
+    from raft_tpu.data.datasets import _native_decodable, _read_image
+
+    im8 = (np.arange(48 * 32 * 3, dtype=np.uint32) % 256).astype(np.uint8)
+    im8 = im8.reshape(48, 32, 3)
+    ok, png8 = cv2.imencode(".png", im8)
+    assert ok
+    im16 = (np.arange(48 * 32 * 3, dtype=np.uint32) * 257 % 65536).astype(np.uint16)
+    im16 = im16.reshape(48, 32, 3)
+    ok, png16 = cv2.imencode(".png", im16)
+    assert ok
+    ok, jpg = cv2.imencode(".jpg", im8)
+    assert ok
+
+    assert _native_decodable(bytes(png8)) is True
+    assert _native_decodable(bytes(png16)) is False
+    assert _native_decodable(bytes(jpg)) is True
+
+    # and the full reader agrees with cv2 on a 16-bit file regardless of
+    # whether the native library is present
+    p = tmp_path / "deep.png"
+    p.write_bytes(bytes(png16))
+    got = _read_image(p)
+    want = cv2.imdecode(np.frombuffer(bytes(png16), np.uint8), cv2.IMREAD_COLOR)
+    np.testing.assert_array_equal(got, want)

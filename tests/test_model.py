@@ -132,3 +132,14 @@ def test_bfloat16_compute():
     diff = np.abs(np.asarray(out.flow) - np.asarray(ref.flow)).mean()
     scale = np.abs(np.asarray(ref.flow)).mean() + 1e-6
     assert diff / scale < 0.5, (diff, scale)
+
+
+@pytest.mark.parametrize("impl", ["dense", "blockwise", "pallas"])
+def test_unknown_corr_lookup_rejected_all_impls(impl):
+    """A corr_lookup typo must raise for EVERY impl, not silently fall back
+    to the gather path (the blockwise branch used to do exactly that)."""
+    cfg = RAFTConfig.full(iters=1, corr_impl=impl, corr_lookup="one-hot")
+    params = init_raft(jax.random.PRNGKey(0), cfg)
+    im = jnp.zeros((1, 32, 32, 3))
+    with pytest.raises(ValueError, match="corr_lookup"):
+        raft_forward(params, im, im, cfg)

@@ -181,3 +181,38 @@ def test_train_crash_resume_end_to_end(tmp_path):
     records = [json.loads(l) for l in (ckpt / "metrics.jsonl").read_text().splitlines()]
     assert records[0]["step"] == 0 and records[-1]["step"] == 9
     assert all(np.isfinite(r["loss"]) for r in records)
+
+
+def test_metrics_stream_truncated_on_resume(tmp_path):
+    """A crash after logging but before the next checkpoint leaves metrics
+    records past the restored step; resume must drop them so the stream has
+    one record per step (no duplicate/conflicting entries)."""
+    import json
+
+    from raft_tpu.data.pipeline import synthetic_batches
+    from raft_tpu.training.loop import train
+
+    config = RAFTConfig.small_model(iters=2)
+    ckpt = tmp_path / "ckpts"
+    logs = []
+
+    def run(num_steps, log_every):
+        tconfig = TrainConfig(num_steps=num_steps, batch_size=2, lr=1e-4,
+                              schedule="constant", ckpt_every=4,
+                              log_every=log_every, image_size=(32, 48))
+        return train(config, tconfig, synthetic_batches(2, (32, 48)),
+                     ckpt_dir=str(ckpt), data_parallel=False,
+                     log_fn=logs.append)
+
+    # 6 steps, checkpoint at step 4, logs at 0,1,...,5 -> records for steps
+    # 4 and 5 are PAST the last periodic checkpoint... but train() also saves
+    # a final checkpoint; delete it to simulate the crash after step 6.
+    run(6, log_every=1)
+    (ckpt / "ckpt_6.npz").unlink()
+    run(8, log_every=1)
+    assert any("resumed" in line and "at step 4" in line for line in logs)
+    assert any("dropped" in line and "replayed" in line for line in logs)
+    records = [json.loads(l) for l in (ckpt / "metrics.jsonl").read_text().splitlines()]
+    steps = [r["step"] for r in records]
+    assert steps == sorted(set(steps)), steps   # strictly increasing, no dups
+    assert steps[-1] == 7
