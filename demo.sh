@@ -1,0 +1,31 @@
+#!/bin/sh
+# Zero-thought demo — the reference's infer_image.sh equivalent
+# (reference infer_image.sh:1-3 ran both variants on the committed Sintel
+# frame pair).  Usage:
+#
+#   ./demo.sh [full_ckpt] [small_ckpt]
+#
+# Each argument is optional and per-variant (a checkpoint fits only one
+# architecture): official .pth, reference .npz, or native .npz.  Without
+# checkpoints the demo still runs end to end on random weights (structure/
+# throughput proof only — the colorized flow will be noise).
+# For trainability proof-of-life with no downloads at all:
+#
+#   python -m raft_tpu.cli --demo-train
+set -e
+cd "$(dirname "$0")"
+if [ -n "$1" ]; then
+    python -m raft_tpu.cli -m test --load "$1" \
+        --im1 assets/frame_0016.png --im2 assets/frame_0017.png --out output_raft
+else
+    python -m raft_tpu.cli -m test \
+        --im1 assets/frame_0016.png --im2 assets/frame_0017.png --out output_raft
+fi
+if [ -n "$2" ]; then
+    python -m raft_tpu.cli -m test --small --load "$2" \
+        --im1 assets/frame_0016.png --im2 assets/frame_0017.png --out output_raft
+else
+    python -m raft_tpu.cli -m test --small \
+        --im1 assets/frame_0016.png --im2 assets/frame_0017.png --out output_raft
+fi
+echo "results in ./output_raft/"

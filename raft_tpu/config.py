@@ -47,6 +47,12 @@ class RAFTConfig:
     # MXU inputs, matching the dense/blockwise einsum default and ~1.6x
     # faster). Bilinear-interpolation matmuls always run at highest.
     corr_precision: str = "highest"
+    # Fused-kernel block sizes (corr_impl='pallas'): queries per program and
+    # target level-0 tile width (rows of fmap2 per program x padded W2).
+    # Defaults chosen from the measured sweep on TPU v5e — tools/tune_pallas.py,
+    # table in TUNING.md — not guesses.
+    pallas_q_blk: int = 128
+    pallas_p_blk: int = 4096
     # Compute dtype for conv/matmul-heavy paths ('float32' or 'bfloat16');
     # the correlation itself always accumulates in float32.
     compute_dtype: str = "float32"
@@ -94,6 +100,12 @@ class TrainConfig:
     schedule: str = "one_cycle"  # one_cycle | constant | cyclic
     pct_start: float = 0.05
     max_flow: float = 400.0      # exclude ground-truth flows beyond this
+    # Failure detection/containment (SURVEY.md §5 listed 'none' for the
+    # reference): drop updates with non-finite grads (optax.apply_if_finite),
+    # and the loop halts with a clear error if the loss itself goes
+    # non-finite at a logged step (halt_on_nonfinite).
+    skip_nonfinite_updates: bool = True
+    halt_on_nonfinite: bool = True
     seed: int = 0
     log_every: int = 100
     ckpt_every: int = 5000

@@ -70,10 +70,17 @@ class FlowDataset:
             return read_pfm(path)[:, :, :2], None
         return read_flo(path), None
 
-    def __getitem__(self, idx):
+    def _load(self, idx) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                  Optional[np.ndarray]]:
+        """Produce raw (im1 uint8, im2 uint8, flow, valid-or-None); overridden
+        by procedurally-generated datasets (synthetic.py)."""
         im1 = _read_image(self.image_list[idx][0])
         im2 = _read_image(self.image_list[idx][1])
         flow, valid = self._read_flow(idx)
+        return im1, im2, flow, valid
+
+    def __getitem__(self, idx):
+        im1, im2, flow, valid = self._load(idx)
         if self.augmentor is not None:
             if valid is not None:
                 if not getattr(self.augmentor, "accepts_valid", False):
@@ -201,7 +208,10 @@ class PairList:
 def make_training_dataset(stage: str, root: str,
                           crop_size: Tuple[int, int]) -> FlowDataset:
     """Stage presets following the official curriculum: chairs -> things ->
-    sintel/kitti finetune."""
+    sintel/kitti finetune; 'synthetic' needs no root (procedural data)."""
+    if stage == "synthetic":
+        from .synthetic import SyntheticFlowDataset
+        return SyntheticFlowDataset(size=crop_size)
     if stage == "chairs":
         aug = FlowAugmentor(crop_size, min_scale=-0.1, max_scale=1.0)
         return FlyingChairs(root, "training", aug)

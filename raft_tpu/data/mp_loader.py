@@ -1,0 +1,153 @@
+"""Multi-process host-side sample loading.
+
+The decode+augment path (datasets._read_image + augment.FlowAugmentor) is
+GIL-bound numpy/cv2 work; a single pump thread tops out well below a TPU
+step rate at training shapes.  This is the tensorpack-PrefetchDataZMQ analog
+(reference dataflow/test_dataflow.py:7, imported there but never used):
+worker *processes* each run ``dataset[idx]`` and stream finished samples back
+over bounded queues, so augmentation scales across cores while the batching /
+device staging stays in the main process (pipeline.PrefetchLoader).
+
+Design notes:
+* fork start method — workers inherit the dataset by COW, no pickling of the
+  file lists; workers touch only numpy/cv2, never jax.
+* per-sample determinism — each task carries a seed derived from (loader
+  seed, epoch, index) and reseeds the augmentor's RandomState before the
+  item is produced, so sample *content* is reproducible even though arrival
+  *order* depends on worker scheduling.  (Training consumes a shuffled
+  stream, so order nondeterminism is harmless.)
+* bounded task/result queues — backpressure instead of unbounded buffering
+  (multiprocessing.Pool.imap would eagerly drain the infinite index stream).
+"""
+
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue
+import threading
+import time
+import traceback
+from typing import Iterator, Optional
+
+import numpy as np
+
+_SENTINEL = None
+
+
+def _worker_loop(dataset, tasks, results):
+    while True:
+        task = tasks.get()
+        if task is _SENTINEL:
+            break
+        idx, sample_seed = task
+        try:
+            aug = getattr(dataset, "augmentor", None)
+            if aug is not None and hasattr(aug, "rng"):
+                aug.rng = np.random.RandomState(sample_seed)
+            results.put(("ok", dataset[idx]))
+        except BaseException:
+            results.put(("error", traceback.format_exc()))
+            break
+
+
+class MPSampleLoader:
+    """Iterator of (im1, im2, flow, valid) samples produced by worker
+    processes; feed it to pipeline.batched + PrefetchLoader."""
+
+    def __init__(self, dataset, num_workers: int = 4, seed: int = 0,
+                 shuffle: bool = True, epochs: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 poll_timeout: float = 10.0):
+        assert num_workers >= 1
+        self._poll_timeout = poll_timeout
+        ctx = mp.get_context("fork")
+        depth = queue_depth or 2 * num_workers
+        self._tasks = ctx.Queue(maxsize=depth)
+        self._results = ctx.Queue(maxsize=depth)
+        self._workers = [
+            ctx.Process(target=_worker_loop,
+                        args=(dataset, self._tasks, self._results),
+                        daemon=True)
+            for _ in range(num_workers)]
+        for w in self._workers:
+            w.start()
+        self._closed = False
+        self._n_tasks = (len(dataset) * epochs) if epochs is not None else None
+        self._feeder = threading.Thread(
+            target=self._feed, args=(dataset, seed, shuffle, epochs),
+            daemon=True)
+        self._feeder.start()
+
+    def _feed(self, dataset, seed, shuffle, epochs):
+        rng = np.random.RandomState(seed)
+        for epoch in itertools.count():
+            if epochs is not None and epoch >= epochs:
+                break
+            order = np.arange(len(dataset))
+            if shuffle:
+                rng.shuffle(order)
+            for idx in order:
+                sample_seed = (seed * 1_000_003 + epoch * 97_003
+                               + int(idx)) % (2**31)
+                if self._closed:
+                    return
+                self._tasks.put((int(idx), sample_seed))
+        for _ in self._workers:
+            self._tasks.put(_SENTINEL)
+
+    def __iter__(self) -> Iterator:
+        served = 0
+        while self._n_tasks is None or served < self._n_tasks:
+            while True:
+                try:
+                    status, payload = self._results.get(
+                        timeout=self._poll_timeout)
+                    break
+                except queue.Empty:
+                    # a worker killed by the OS (segfault, OOM killer) never
+                    # queues an 'error' record — detect the silent death
+                    # instead of hanging the training job forever
+                    if not any(w.is_alive() for w in self._workers):
+                        self.close()
+                        raise RuntimeError(
+                            "all data workers died without reporting (killed "
+                            "by the OS? check dmesg for OOM)") from None
+            if status == "error":
+                self.close()
+                raise RuntimeError(f"data worker failed:\n{payload}")
+            served += 1
+            yield payload
+        self.close()
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        # unblock the feeder if it is parked in a full-queue put(): drain the
+        # task queue so its in-flight put completes, after which its _closed
+        # check returns — otherwise every closed loader leaks a live thread
+        for _ in range(3):
+            try:
+                while True:
+                    self._tasks.get_nowait()
+            except queue.Empty:
+                pass
+            self._feeder.join(timeout=0.5)
+            if not self._feeder.is_alive():
+                break
+        for w in self._workers:
+            w.terminate()
+        for w in self._workers:
+            w.join(timeout=5)
+
+
+def measure_rate(sample_iter, n: int, warmup: int = 2) -> float:
+    """Samples/sec of an iterator, after ``warmup`` discarded samples."""
+    it = iter(sample_iter)
+    for _ in range(warmup):
+        next(it)
+    t0 = time.time()
+    for _ in range(n):
+        next(it)
+    return n / (time.time() - t0)

@@ -1,0 +1,101 @@
+"""Procedural optical-flow dataset with exact ground truth.
+
+Trainability evidence without downloads: the real flow datasets
+(FlyingChairs/Things/Sintel — SURVEY.md §6) are unreachable in a sandboxed
+environment, so this generates textured image pairs whose flow is known by
+construction.  Each sample is built from one multi-octave noise canvas:
+frame 2 is a central crop, and frame 1 is the canvas resampled at
+``x + flow(x)`` — so ``im1(x) == im2(x + flow(x))`` exactly (up to bilinear
+interpolation), matching the model's flow convention (ops/coords.py:
+flow = coords1 - coords0 indexes frame 2 from frame 1 pixels).
+
+The flow field is a random affine (translation/rotation/log-scale) plus a
+smooth low-frequency displacement, bounded by ``max_flow`` which in turn is
+bounded by the canvas margin, so every pixel stays in-bounds and the whole
+validity mask is 1.
+
+Deterministic per (seed, index): the same index always yields the same
+sample, so an eval split is just a different seed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .datasets import FlowDataset
+
+
+def _noise_texture(rng: np.random.RandomState, h: int, w: int) -> np.ndarray:
+    """Multi-octave color noise: structure at several scales so local windows
+    are discriminative for matching (pure white noise would alias under the
+    /8 feature encoder)."""
+    import cv2
+    canvas = np.zeros((h, w, 3), np.float32)
+    amp, total = 1.0, 0.0
+    for octave in (4, 8, 16, 32, 64):
+        gh, gw = max(h // octave, 2), max(w // octave, 2)
+        grid = rng.rand(gh, gw, 3).astype(np.float32)
+        canvas += amp * cv2.resize(grid, (w, h), interpolation=cv2.INTER_CUBIC)
+        total += amp
+        amp *= 0.6
+    canvas /= total
+    return np.clip(canvas * 255.0, 0, 255).astype(np.uint8)
+
+
+def _smooth_field(rng: np.random.RandomState, h: int, w: int,
+                  cells: int, scale: float) -> np.ndarray:
+    """[H, W, 2] low-frequency displacement in [-scale, scale]."""
+    import cv2
+    grid = (rng.rand(cells, cells, 2).astype(np.float32) * 2 - 1) * scale
+    return cv2.resize(grid, (w, h), interpolation=cv2.INTER_CUBIC)
+
+
+class SyntheticFlowDataset(FlowDataset):
+    """Endless-by-index procedural (im1, im2, flow, valid) samples."""
+
+    def __init__(self, size: Tuple[int, int] = (96, 128), length: int = 1000,
+                 max_flow: float = 6.0, seed: int = 0, augmentor=None):
+        super().__init__(augmentor)
+        self.size = tuple(size)
+        self.length = int(length)
+        self.max_flow = float(max_flow)
+        self.seed = int(seed)
+
+    def __len__(self) -> int:
+        return self.length
+
+    def _load(self, idx):
+        import cv2
+        rng = np.random.RandomState((self.seed * 1_000_003 + idx) % (2**31))
+        h, w = self.size
+        margin = int(np.ceil(self.max_flow)) + 2
+        ch, cw = h + 2 * margin, w + 2 * margin
+        canvas = _noise_texture(rng, ch, cw)
+
+        # affine component about the frame center
+        angle = rng.uniform(-0.03, 0.03)
+        log_scale = rng.uniform(-0.04, 0.04)
+        tx, ty = rng.uniform(-0.5, 0.5, 2) * self.max_flow
+        ys, xs = np.mgrid[0:h, 0:w].astype(np.float32)
+        cx, cy = (w - 1) / 2.0, (h - 1) / 2.0
+        dx, dy = xs - cx, ys - cy
+        s = np.exp(log_scale)
+        fx = (s * (np.cos(angle) * dx - np.sin(angle) * dy) - dx) + tx
+        fy = (s * (np.sin(angle) * dx + np.cos(angle) * dy) - dy) + ty
+        # plus a smooth non-rigid displacement
+        bump = _smooth_field(rng, h, w, cells=4, scale=0.35 * self.max_flow)
+        flow = np.stack([fx, fy], -1) + bump
+        # bound to the canvas margin so no sample reads out of bounds
+        mag = np.linalg.norm(flow, axis=-1, keepdims=True)
+        limit = self.max_flow
+        flow = np.where(mag > limit, flow * (limit / np.maximum(mag, 1e-9)),
+                        flow).astype(np.float32)
+
+        im2 = canvas[margin:margin + h, margin:margin + w]
+        # im1(x) = canvas(x + margin + flow(x)) = im2(x + flow(x))
+        map_x = xs + margin + flow[..., 0]
+        map_y = ys + margin + flow[..., 1]
+        im1 = cv2.remap(canvas, map_x, map_y, interpolation=cv2.INTER_LINEAR)
+        return im1, im2, flow, None

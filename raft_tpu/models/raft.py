@@ -163,7 +163,9 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                 "TPU kernel); use 'dense' or 'blockwise'.") from e
         lookup = make_fused_lookup(fmap1c, fmap2c, config.corr_levels,
                                    config.corr_radius,
-                                   corr_precision=corr_prec)
+                                   corr_precision=corr_prec,
+                                   q_blk=config.pallas_q_blk,
+                                   p_blk_target=config.pallas_p_blk)
     else:
         raise ValueError(config.corr_impl)
 

@@ -170,7 +170,7 @@ def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
 
 def _fused_lookup_impl(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
                        coords: jax.Array, radius: int,
-                       q_blk: int = 128, p_blk_target: int = 2048,
+                       q_blk: int = 128, p_blk_target: int = 4096,
                        interpret: Optional[bool] = None,
                        corr_precision=jax.lax.Precision.HIGHEST) -> jax.Array:
     B, H, W, C = fmap1.shape
@@ -187,26 +187,31 @@ def _fused_lookup_impl(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
     return jnp.concatenate(outs, axis=-1).reshape(B, H, W, -1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def fused_lookup(fmap1: jax.Array, f2_levels: Tuple[jax.Array, ...],
                  coords: jax.Array, radius: int,
-                 corr_precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+                 corr_precision=jax.lax.Precision.HIGHEST,
+                 q_blk: int = 128, p_blk_target: int = 4096) -> jax.Array:
     """Pallas-fused correlation lookup.
 
     fmap1 [B,H,W,C], f2_levels tuple of [B,H/2^i,W/2^i,C], coords [B,H,W,2]
     -> [B, H, W, L*(2r+1)^2], matching ``ops.corr.lookup_dense`` exactly.
     """
     return _fused_lookup_impl(fmap1, f2_levels, coords, radius,
+                              q_blk=q_blk, p_blk_target=p_blk_target,
                               corr_precision=corr_precision)
 
 
-def _fused_lookup_fwd(fmap1, f2_levels, coords, radius, corr_precision):
+def _fused_lookup_fwd(fmap1, f2_levels, coords, radius, corr_precision,
+                      q_blk, p_blk_target):
     return _fused_lookup_impl(fmap1, f2_levels, coords, radius,
+                              q_blk=q_blk, p_blk_target=p_blk_target,
                               corr_precision=corr_precision), (
         fmap1, f2_levels, coords)
 
 
-def _fused_lookup_bwd(radius, corr_precision, residuals, g):
+def _fused_lookup_bwd(radius, corr_precision, q_blk, p_blk_target,
+                      residuals, g):
     # gradients via the matmul-only XLA twin (no gathers in the backward);
     # the configured corr precision applies to the backward matmuls too —
     # 'highest' must not silently degrade to bf16 MXU inputs in training
@@ -222,7 +227,8 @@ fused_lookup.defvjp(_fused_lookup_fwd, _fused_lookup_bwd)
 
 
 def make_fused_lookup(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
-                      radius: int, corr_precision="highest"):
+                      radius: int, corr_precision="highest",
+                      q_blk: int = 128, p_blk_target: int = 4096):
     """Build the per-iteration lookup closure used by models/raft.py.
 
     Pools the fmap2 pyramid once; each GRU iteration then runs the fused
@@ -239,6 +245,7 @@ def make_fused_lookup(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
                 else jax.lax.Precision.DEFAULT)
 
     def lookup(coords: jax.Array) -> jax.Array:
-        return fused_lookup(fmap1, f2_levels, coords, radius, prec)
+        return fused_lookup(fmap1, f2_levels, coords, radius, prec,
+                            q_blk, p_blk_target)
 
     return lookup

@@ -60,6 +60,24 @@ def restore_checkpoint(path, template):
     return jax.tree.unflatten(treedef, restored)
 
 
+def restore_checkpoint_compat(path, template):
+    """``restore_checkpoint`` that also accepts checkpoints saved before the
+    optimizer was wrapped in ``optax.apply_if_finite`` (TrainConfig.
+    skip_nonfinite_updates): on a leaf-count mismatch with a wrapped
+    template, the inner optimizer state is restored and fresh wrapper
+    counters are attached — counters are run diagnostics, not model state."""
+    try:
+        return restore_checkpoint(path, template)
+    except ValueError:
+        opt = getattr(template, "opt_state", None)
+        if type(opt).__name__ != "ApplyIfFiniteState":
+            raise
+        inner_template = template._replace(opt_state=opt.inner_state)
+        restored = restore_checkpoint(path, inner_template)
+        return restored._replace(
+            opt_state=opt._replace(inner_state=restored.opt_state))
+
+
 def latest_checkpoint(ckpt_dir) -> Optional[Path]:
     """Newest step-numbered checkpoint in a directory (ckpt_<step>.npz)."""
     ckpt_dir = Path(ckpt_dir)

@@ -19,7 +19,8 @@ import numpy as np
 
 from ..config import RAFTConfig, TrainConfig
 from ..models import init_raft
-from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
+from .checkpoint import (latest_checkpoint, restore_checkpoint_compat,
+                         save_checkpoint)
 from .optim import make_optimizer
 from .state import TrainState
 from .step import Batch, make_train_step
@@ -54,7 +55,7 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
     if ckpt_dir and resume:
         latest = latest_checkpoint(ckpt_dir)
         if latest is not None:
-            state = restore_checkpoint(latest, state)
+            state = restore_checkpoint_compat(latest, state)
             start_step = int(state.step)
             log_fn(f"[train] resumed from {latest} at step {start_step}")
 
@@ -72,10 +73,13 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
     metrics_path = Path(ckpt_dir) / "metrics.jsonl" if ckpt_dir else None
     if metrics_path:
         metrics_path.parent.mkdir(parents=True, exist_ok=True)
-        if start_step and metrics_path.exists():
+        if metrics_path.exists():
             # a crash between a logged step and the next checkpoint leaves
-            # records past the restored step; drop them so the stream stays
-            # one record per step across resumes
+            # records past the restored step (possibly a partial trailing
+            # line); drop them so the stream stays one record per step across
+            # resumes — including start_step 0, where a previous run that
+            # died before its first checkpoint left records a fresh run in
+            # the same directory must not append after
             lines = [ln for ln in metrics_path.read_text().splitlines()
                      if ln.strip()]
 
@@ -94,6 +98,7 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
     rng = jax.random.PRNGKey(tconfig.seed + 1)
     t0 = time.time()
     seen = 0
+    nonfinite_streak = 0   # consecutive *logged* steps with non-finite loss
     for batch_np in batch_iter:
         step = int(state.step)
         if step >= tconfig.num_steps:
@@ -121,19 +126,47 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
                 rec.update({k: float(v) for k, v in m.items()})
                 with open(metrics_path, "a") as f:
                     f.write(json.dumps(rec) + "\n")
+            # failure detection: an isolated bad batch is contained by
+            # apply_if_finite (update dropped, params stay healthy) — only
+            # *persistent* non-finiteness means the run is actually diverged
+            # and should stop rather than burn the remaining budget
+            if not np.isfinite(float(m["loss"])):
+                nonfinite_streak += 1
+            else:
+                nonfinite_streak = 0
+            if tconfig.halt_on_nonfinite and nonfinite_streak >= 3:
+                if tracing:
+                    jax.profiler.stop_trace()
+                raise FloatingPointError(
+                    f"non-finite loss at {nonfinite_streak} consecutive "
+                    f"logged steps (last: step {step}); last good checkpoint "
+                    f"is in {ckpt_dir or '<none>'}")
         if ckpt_dir and (step + 1) % tconfig.ckpt_every == 0:
-            p = Path(ckpt_dir) / f"ckpt_{step + 1}.npz"
-            save_checkpoint(p, jax.device_get(state))
-            log_fn(f"[train] saved {p}")
+            _save_if_finite(Path(ckpt_dir) / f"ckpt_{step + 1}.npz",
+                            state, log_fn)
 
     if tracing:
         jax.profiler.stop_trace()
         log_fn(f"[train] wrote profiler trace to {trace_dir}")
     if ckpt_dir:
-        p = Path(ckpt_dir) / f"ckpt_{int(state.step)}.npz"
-        save_checkpoint(p, jax.device_get(state))
-        log_fn(f"[train] saved final {p}")
+        _save_if_finite(Path(ckpt_dir) / f"ckpt_{int(state.step)}.npz",
+                        state, log_fn, final=True)
     return state
+
+
+def _save_if_finite(path: Path, state: TrainState, log_fn, final: bool = False):
+    """Never persist poisoned params: a checkpoint written after NaN updates
+    slipped through (apply_if_finite passes through after its error budget)
+    would later be resumed as the 'last good' state."""
+    host_state = jax.device_get(state)
+    bad = [() for x in jax.tree.leaves(host_state.params)
+           if not np.isfinite(np.asarray(x)).all()]
+    if bad:
+        log_fn(f"[train] NOT saving {path}: {len(bad)} param tensor(s) "
+               f"non-finite (diverged); last good checkpoint is unchanged")
+        return
+    save_checkpoint(path, host_state)
+    log_fn(f"[train] saved {'final ' if final else ''}{path}")
 
 
 def train_cli(args, config: RAFTConfig) -> int:
@@ -146,20 +179,51 @@ def train_cli(args, config: RAFTConfig) -> int:
         overrides["lr"] = args.lr
     overrides["optimizer"] = args.optimizer
     overrides["batch_size"] = args.batch
+    if getattr(args, "train_size", None):
+        overrides["image_size"] = tuple(args.train_size)
+    if args.dataset == "synthetic":
+        # procedural data: small frames, tight logging so the EPE curve in
+        # metrics.jsonl is dense enough to read as trainability evidence
+        overrides.setdefault("image_size", (96, 128))
+        overrides.setdefault("log_every", 10)
+        overrides.setdefault("ckpt_every", 100)
     tconfig = TrainConfig(**overrides)
 
-    if args.data:
+    if args.data or args.dataset == "synthetic":
         from ..data.datasets import make_training_dataset
         ds = make_training_dataset(args.dataset, args.data, tconfig.image_size)
         print(f"[train] {args.dataset}: {len(ds)} samples")
-        batch_iter = PrefetchLoader(
-            batched(ds.sample_iter(seed=tconfig.seed), tconfig.batch_size))
+        workers = getattr(args, "workers", 0)
+        if workers >= 1:
+            from ..data.mp_loader import MPSampleLoader
+            sample_iter = MPSampleLoader(ds, num_workers=workers,
+                                         seed=tconfig.seed)
+            print(f"[train] {workers} decode/augment worker processes")
+        else:
+            sample_iter = ds.sample_iter(seed=tconfig.seed)
+        batch_iter = PrefetchLoader(batched(sample_iter, tconfig.batch_size))
     else:
-        print("[train] no --data: running on SYNTHETIC batches (smoke mode)")
+        print("[train] no --data: running on RANDOM batches (smoke mode; "
+              "use --dataset synthetic for data with real ground truth)")
         size = (64, 96)
         batch_iter = PrefetchLoader(synthetic_batches(tconfig.batch_size, size))
 
     ckpt_dir = str(Path(args.out) / tconfig.ckpt_dir)
     train(config, tconfig, batch_iter, ckpt_dir=ckpt_dir,
           trace_dir=getattr(args, "trace", None))
+
+    metrics_path = Path(ckpt_dir) / "metrics.jsonl"
+    if metrics_path.exists():
+        records = []
+        for ln in metrics_path.read_text().splitlines():
+            try:
+                records.append(json.loads(ln))
+            except json.JSONDecodeError:
+                pass   # partial line from a crash mid-append
+
+        if len(records) >= 2:
+            first, last = records[0], records[-1]
+            print(f"[train] EPE trajectory: step {first['step']} -> "
+                  f"{first['epe']:.3f}  ...  step {last['step']} -> "
+                  f"{last['epe']:.3f}  (curve: {metrics_path})")
     return 0

@@ -69,4 +69,11 @@ def make_optimizer(tc: TrainConfig, schedule=None) -> optax.GradientTransformati
         opt = optax.sgd(sched, momentum=0.9, nesterov=False)
     else:
         raise ValueError(name)
-    return optax.chain(optax.clip_by_global_norm(tc.clip_norm), opt)
+    opt = optax.chain(optax.clip_by_global_norm(tc.clip_norm), opt)
+    if tc.skip_nonfinite_updates:
+        # failure containment: a batch that produces inf/nan gradients is
+        # dropped (zero update) instead of poisoning params + Adam moments;
+        # after max_consecutive_errors poisoned steps in a row updates pass
+        # through again, which the loop's finite-loss halt then catches.
+        opt = optax.apply_if_finite(opt, max_consecutive_errors=8)
+    return opt

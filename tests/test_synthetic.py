@@ -1,0 +1,184 @@
+"""Synthetic-flow dataset and multiprocess loader tests, plus the
+trainability drill (VERDICT round 1, next-round #4): training on procedural
+data with exact ground truth must drive EPE far below random-init."""
+
+import json
+
+import numpy as np
+import pytest
+
+from raft_tpu.data.datasets import make_training_dataset
+from raft_tpu.data.mp_loader import MPSampleLoader
+from raft_tpu.data.synthetic import SyntheticFlowDataset
+
+
+def test_flow_convention_exact():
+    """im1(x) must equal im2(x + flow(x)): warping im2 by the ground-truth
+    flow reconstructs im1 wherever the lookup stays inside im2."""
+    import cv2
+    ds = SyntheticFlowDataset(size=(64, 96), length=4, max_flow=5.0, seed=1)
+    im1, im2, flow, valid = ds[2]
+    h, w = flow.shape[:2]
+    ys, xs = np.mgrid[0:h, 0:w].astype(np.float32)
+    map_x = xs + flow[..., 0]
+    map_y = ys + flow[..., 1]
+    recon = cv2.remap((im2 * 255).astype(np.uint8), map_x, map_y,
+                      interpolation=cv2.INTER_LINEAR).astype(np.float32) / 255
+    inside = ((map_x >= 0) & (map_x <= w - 1)
+              & (map_y >= 0) & (map_y <= h - 1))
+    assert inside.mean() > 0.5
+    err = np.abs(recon - im1).max(-1)[inside]
+    assert err.max() < 3 / 255, err.max()
+    np.testing.assert_array_equal(valid, 1.0)
+
+
+def test_sample_determinism_and_diversity():
+    ds = SyntheticFlowDataset(size=(48, 64), length=10, seed=3)
+    a1 = ds[5]
+    a2 = ds[5]
+    for x, y in zip(a1, a2):
+        np.testing.assert_array_equal(x, y)
+    b = ds[6]
+    assert not np.array_equal(a1[2], b[2])
+    # different seed => different data at the same index
+    other = SyntheticFlowDataset(size=(48, 64), length=10, seed=4)
+    assert not np.array_equal(a1[2], other[5][2])
+
+
+def test_flow_bounded_by_max_flow():
+    ds = SyntheticFlowDataset(size=(48, 64), length=3, max_flow=4.0)
+    for i in range(3):
+        flow = ds[i][2]
+        mag = np.linalg.norm(flow, axis=-1)
+        assert mag.max() <= 4.0 + 1e-4, mag.max()
+        assert mag.mean() > 0.3          # flows are non-trivial
+
+
+def test_factory_no_root_needed():
+    ds = make_training_dataset("synthetic", None, (64, 96))
+    im1, im2, flow, valid = ds[0]
+    assert im1.shape == (64, 96, 3) and flow.shape == (64, 96, 2)
+    assert im1.dtype == np.float32 and 0.0 <= im1.min() <= im1.max() <= 1.0
+
+
+# ---------------------------------------------------------------- MP loader
+
+def test_mp_loader_matches_sequential_multiset():
+    """2 workers, 2 epochs: the loader must deliver exactly every index twice
+    (content identity; order is scheduling-dependent by design)."""
+    ds = SyntheticFlowDataset(size=(32, 48), length=5, seed=0)
+    expected = {ds[i][2].tobytes(): 2 for i in range(5)}
+    loader = MPSampleLoader(ds, num_workers=2, seed=0, epochs=2)
+    try:
+        for sample in loader:
+            key = sample[2].tobytes()
+            expected[key] -= 1
+    finally:
+        loader.close()
+    assert all(v == 0 for v in expected.values()), expected.values()
+
+
+def test_mp_loader_deterministic_stream_single_worker():
+    """One worker + no shuffle: the stream (incl. augmentor randomness, which
+    is reseeded per sample) is fully reproducible across loaders."""
+    from raft_tpu.data.augment import FlowAugmentor
+    def make():
+        ds = SyntheticFlowDataset(size=(48, 72), length=4, seed=2,
+                                  augmentor=FlowAugmentor((32, 48)))
+        return MPSampleLoader(ds, num_workers=1, seed=7, shuffle=False,
+                              epochs=1)
+    l1, l2 = make(), make()
+    try:
+        for s1, s2 in zip(l1, l2):
+            for x, y in zip(s1, s2):
+                np.testing.assert_array_equal(x, y)
+    finally:
+        l1.close()
+        l2.close()
+
+
+class _Exploding:
+    augmentor = None
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, idx):
+        if idx == 3:
+            raise ValueError("boom at 3")
+        return (np.zeros((8, 8, 3), np.float32),) * 2 + (
+            np.zeros((8, 8, 2), np.float32), np.ones((8, 8), np.float32))
+
+
+def test_mp_loader_propagates_worker_errors():
+    loader = MPSampleLoader(_Exploding(), num_workers=2, seed=0,
+                            shuffle=False, epochs=1)
+    with pytest.raises(RuntimeError, match="boom at 3"):
+        for _ in loader:
+            pass
+    # close() must have unblocked and reaped the feeder thread (no leak even
+    # when the feeder was parked in a full-queue put)
+    loader._feeder.join(timeout=2)
+    assert not loader._feeder.is_alive()
+
+
+def test_mp_loader_detects_silent_worker_death():
+    """Workers killed by the OS (OOM/segfault) queue no error record; the
+    consumer must raise instead of hanging forever."""
+    import os
+    import signal
+    import time
+
+    ds = SyntheticFlowDataset(size=(32, 48), length=100, seed=0)
+    loader = MPSampleLoader(ds, num_workers=2, seed=0, poll_timeout=0.5)
+    try:
+        it = iter(loader)
+        next(it)
+        for w in loader._workers:
+            os.kill(w.pid, signal.SIGKILL)
+        time.sleep(0.2)
+        with pytest.raises(RuntimeError, match="died without reporting"):
+            for _ in range(200):
+                next(it)
+    finally:
+        loader.close()
+
+
+def test_mp_loader_close_unblocks_feeder():
+    """Closing an infinite loader mid-stream must not leak the feeder."""
+    ds = SyntheticFlowDataset(size=(32, 48), length=6, seed=0)
+    loader = MPSampleLoader(ds, num_workers=2, seed=0, queue_depth=2)
+    it = iter(loader)
+    next(it)
+    loader.close()
+    loader._feeder.join(timeout=2)
+    assert not loader._feeder.is_alive()
+    assert all(not w.is_alive() for w in loader._workers)
+
+
+# ------------------------------------------------------- trainability drill
+
+def test_synthetic_training_reduces_epe(tmp_path):
+    """Train raft-small from scratch on procedural flow for ~70 steps: EPE
+    must collapse versus the random-init value and the curve must land in
+    metrics.jsonl.  (The full few-hundred-step run is `--demo-train`; this is
+    its CI-sized cousin.)"""
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.data.pipeline import PrefetchLoader, batched
+    from raft_tpu.training.loop import train
+
+    config = RAFTConfig.small_model(iters=3)
+    tconfig = TrainConfig(num_steps=70, batch_size=2, lr=3e-4,
+                          schedule="constant", image_size=(64, 96),
+                          log_every=5, ckpt_every=1000)
+    ds = SyntheticFlowDataset(size=(64, 96), length=200, max_flow=5.0, seed=0)
+    it = PrefetchLoader(batched(ds.sample_iter(seed=0), tconfig.batch_size))
+    train(config, tconfig, it, ckpt_dir=str(tmp_path), data_parallel=False,
+          log_fn=lambda *_: None)
+
+    records = [json.loads(ln) for ln in
+               (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    assert records[0]["step"] == 0 and records[-1]["step"] == 69
+    first, last = records[0]["epe"], records[-1]["epe"]
+    assert np.isfinite(last)
+    assert last < 0.25 * first, (first, last)

@@ -1,6 +1,8 @@
 """Training stack tests: loss semantics, schedules, train step descends,
 BN-state handling, checkpoint round trip."""
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -149,6 +151,169 @@ def test_trained_step_improves_epe_vs_init():
     for _ in range(30):
         state, m = step(state, batch, rng)
     assert float(m["epe"]) < float(m0["epe"]), (float(m0["epe"]), float(m["epe"]))
+
+
+def test_restore_compat_pre_apply_if_finite_checkpoint(tmp_path):
+    """Checkpoints saved before the optimizer grew the apply_if_finite
+    wrapper must still restore (inner opt state recovered, fresh counters)."""
+    from raft_tpu.training.checkpoint import restore_checkpoint_compat
+
+    config = RAFTConfig.small_model(iters=2)
+    old_tc = TrainConfig(num_steps=10, lr=1e-4, schedule="constant",
+                         skip_nonfinite_updates=False)
+    new_tc = dataclasses.replace(old_tc, skip_nonfinite_updates=True)
+    old_state = TrainState.create(init_raft(jax.random.PRNGKey(0), config),
+                                  make_optimizer(old_tc))
+    step = jax.jit(make_train_step(config, old_tc, make_optimizer(old_tc)))
+    old_state, _ = step(old_state, _tiny_batch(), jax.random.PRNGKey(1))
+    p = tmp_path / "ckpt_1.npz"
+    save_checkpoint(p, jax.device_get(old_state))
+
+    new_tx = make_optimizer(new_tc)
+    template = TrainState.create(init_raft(jax.random.PRNGKey(7), config),
+                                 new_tx)
+    restored = restore_checkpoint_compat(p, template)
+    assert int(restored.step) == 1
+    assert type(restored.opt_state).__name__ == "ApplyIfFiniteState"
+    for a, b in zip(jax.tree.leaves(old_state.params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+    # and it keeps training
+    step2 = jax.jit(make_train_step(config, new_tc, new_tx))
+    _, m = step2(restored, _tiny_batch(), jax.random.PRNGKey(2))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_checkpoint_skipped_when_params_nonfinite(tmp_path):
+    """A diverged state must never be persisted as a checkpoint."""
+    from raft_tpu.training.loop import _save_if_finite
+
+    config = RAFTConfig.small_model(iters=2)
+    tconfig = TrainConfig(num_steps=10, lr=1e-4, schedule="constant")
+    tx = make_optimizer(tconfig)
+    state = TrainState.create(init_raft(jax.random.PRNGKey(0), config), tx)
+    poisoned = state._replace(params=jax.tree.map(
+        lambda x: jnp.full_like(x, jnp.nan), state.params))
+    logs = []
+    p = tmp_path / "ckpt_5.npz"
+    _save_if_finite(p, poisoned, logs.append)
+    assert not p.exists()
+    assert any("NOT saving" in l for l in logs)
+    _save_if_finite(p, state, logs.append)
+    assert p.exists()
+
+
+def test_metrics_stream_truncated_for_fresh_run(tmp_path):
+    """A previous run that died before its first checkpoint leaves stale
+    records (possibly a torn trailing line); a fresh run in the same dir must
+    start the stream clean, not append after garbage."""
+    import json
+
+    from raft_tpu.data.pipeline import synthetic_batches
+    from raft_tpu.training.loop import train
+
+    ckpt = tmp_path / "ckpts"
+    ckpt.mkdir()
+    stale = '{"step": 0, "loss": 1.0}\n{"step": 1, "loss"'   # torn tail
+    (ckpt / "metrics.jsonl").write_text(stale)
+
+    config = RAFTConfig.small_model(iters=2)
+    tconfig = TrainConfig(num_steps=2, batch_size=2, lr=1e-4,
+                          schedule="constant", log_every=1,
+                          image_size=(32, 48))
+    train(config, tconfig, synthetic_batches(2, (32, 48)),
+          ckpt_dir=str(ckpt), data_parallel=False, log_fn=lambda *_: None)
+    records = [json.loads(l) for l in
+               (ckpt / "metrics.jsonl").read_text().splitlines()]
+    assert [r["step"] for r in records] == [0, 1]
+    assert all("epe" in r for r in records)   # no stale schema-less records
+
+
+def test_nonfinite_grads_skipped():
+    """Failure containment: a poisoned batch (NaN pixels) must leave params
+    and optimizer moments untouched; the next clean batch updates normally."""
+    config = RAFTConfig.small_model(iters=2)
+    tconfig = TrainConfig(num_steps=10, lr=1e-4, schedule="constant")
+    assert tconfig.skip_nonfinite_updates   # default on
+    tx = make_optimizer(tconfig)
+    state = TrainState.create(init_raft(jax.random.PRNGKey(0), config), tx)
+    step = jax.jit(make_train_step(config, tconfig, tx))
+    rng = jax.random.PRNGKey(1)
+
+    clean = _tiny_batch()
+    poisoned = clean._replace(
+        image1=clean.image1.at[0, 0, 0, 0].set(jnp.nan))
+    before = jax.tree.map(np.asarray, state.params)
+    state, metrics = step(state, poisoned, rng)
+    assert not np.isfinite(float(metrics["loss"]))
+    after = jax.tree.map(np.asarray, state.params)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(a, b)
+
+    state, metrics = step(state, clean, rng)
+    assert np.isfinite(float(metrics["loss"]))
+    changed = jax.tree.map(np.asarray, state.params)
+    assert any(not np.array_equal(a, c) for a, c in
+               zip(jax.tree.leaves(before), jax.tree.leaves(changed)))
+
+
+def test_halt_on_nonfinite_loss(tmp_path):
+    """Failure detection: the loop must stop with a diagnosis when the loss
+    goes non-finite, not keep training a diverged model."""
+    from raft_tpu.training.loop import train
+
+    def poisoned_batches():
+        while True:
+            im = np.full((2, 32, 48, 3), np.nan, np.float32)
+            yield (im, im, np.zeros((2, 32, 48, 2), np.float32),
+                   np.ones((2, 32, 48), np.float32))
+
+    config = RAFTConfig.small_model(iters=2)
+    tconfig = TrainConfig(num_steps=5, batch_size=2, lr=1e-4,
+                          schedule="constant", log_every=1,
+                          image_size=(32, 48))
+    with pytest.raises(FloatingPointError, match="non-finite loss"):
+        train(config, tconfig, poisoned_batches(), ckpt_dir=str(tmp_path),
+              data_parallel=False, log_fn=lambda *_: None)
+
+
+class _MixedResolutionDataset:
+    """Synthetic eval samples whose sizes vary per index (KITTI-style)."""
+
+    # four distinct /8-padded shapes — (24,40),(24,48),(32,40),(32,48) —
+    # that all collapse onto the single /16 bucket (32,48)
+    SIZES = [(18, 34), (20, 44), (28, 36), (30, 44), (26, 42)]
+
+    def __len__(self):
+        return len(self.SIZES)
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        h, w = self.SIZES[idx]
+        return (rng.rand(h, w, 3).astype(np.float32),
+                rng.rand(h, w, 3).astype(np.float32),
+                (rng.randn(h, w, 2) * 2).astype(np.float32),
+                np.ones((h, w), np.float32))
+
+
+def test_eval_resolution_bucketing():
+    """Mixed-resolution eval must hit a bounded number of compiled shapes:
+    bucketing to /16 collapses five distinct sizes onto one padded shape,
+    while minimal /8 padding would compile nearly once per image."""
+    from raft_tpu.training.evaluate import evaluate_dataset
+
+    config = RAFTConfig.small_model(iters=2)
+    params = init_raft(jax.random.PRNGKey(0), config)
+    ds = _MixedResolutionDataset()
+
+    out = evaluate_dataset(params, config, ds, bucket=16, verbose=False)
+    assert out["samples"] == len(ds)
+    assert np.isfinite(out["epe"])
+    assert out["compiled_shapes"] <= 2, out["compiled_shapes"]
+
+    # control: minimal padding really does fragment the shapes
+    out8 = evaluate_dataset(params, config, ds, bucket=8, verbose=False)
+    assert out8["compiled_shapes"] >= 3, out8["compiled_shapes"]
 
 
 def test_train_crash_resume_end_to_end(tmp_path):

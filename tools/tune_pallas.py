@@ -1,0 +1,105 @@
+"""Block-size sweep for the fused Pallas correlation kernel on real TPU.
+
+VERDICT round 1 #9: pick ``q_blk`` / ``p_blk_target`` defaults from measured
+data, not guesses.  Runs the per-GRU-iteration fused lookup (forward path,
+the hot op — 12-32 calls per inference) across block-size combinations at
+the two shapes that matter: the 432x1024 eval/demo resolution and the
+(368,496)-crop batch-6 training shape.  Prints a markdown table + JSON; the
+winners are recorded in TUNING.md and wired into RAFTConfig defaults.
+
+Usage (needs the TPU tunnel; refuses to 'tune' on CPU interpret mode):
+    python tools/tune_pallas.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import itertools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _measure(fn, args, warmup=2, reps=20):
+    import jax
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    out = None
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    float(np.asarray(jax.tree.leaves(out)[0].ravel()[0]))   # true sync
+    return (time.perf_counter() - t0) / reps
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true", help="fewer combos/reps")
+    p.add_argument("--radius", type=int, default=4)
+    p.add_argument("--levels", type=int, default=4)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    if jax.default_backend() != "tpu":
+        print("ERROR: tuning requires the TPU backend (interpret-mode timings "
+              "are meaningless)", file=sys.stderr)
+        return 2
+
+    from raft_tpu.ops.coords import coords_grid
+    from raft_tpu.ops.corr import fmap2_pyramid
+    from raft_tpu.ops.corr_pallas import _fused_lookup_impl
+
+    dev = jax.devices()[0]
+    print(f"# device: {dev.device_kind}")
+
+    # (label, B, full-res H, W); fmaps are at os=8, C=256 (full model)
+    shapes = [("eval 1x432x1024", 1, 432, 1024),
+              ("train 6x368x496", 6, 368, 496)]
+    q_blks = (64, 128, 256) if not args.quick else (128, 256)
+    p_blks = (1024, 2048, 4096, 8192) if not args.quick else (2048, 4096)
+
+    C = 256
+    results = []
+    for label, B, H, W in shapes:
+        h, w = H // 8, W // 8
+        key = jax.random.PRNGKey(0)
+        k1, k2, k3 = jax.random.split(key, 3)
+        fmap1 = jax.random.normal(k1, (B, h, w, C), jnp.float32)
+        fmap2 = jax.random.normal(k2, (B, h, w, C), jnp.float32)
+        f2_levels = tuple(fmap2_pyramid(fmap2, args.levels))
+        coords = (coords_grid(B, h, w)
+                  + jax.random.uniform(k3, (B, h, w, 2), minval=-6, maxval=6))
+        print(f"\n## {label}  (fmap {B}x{h}x{w}x{C})")
+        print("| q_blk | p_blk_target | ms/lookup |")
+        print("|---|---|---|")
+        for q_blk, p_blk in itertools.product(q_blks, p_blks):
+            fn = jax.jit(functools.partial(
+                _fused_lookup_impl, radius=args.radius, q_blk=q_blk,
+                p_blk_target=p_blk, interpret=False))
+            try:
+                dt = _measure(fn, (fmap1, f2_levels, coords),
+                              reps=8 if args.quick else 20)
+                results.append({"shape": label, "q_blk": q_blk,
+                                "p_blk_target": p_blk, "ms": round(dt * 1e3, 4)})
+                print(f"| {q_blk} | {p_blk} | {dt * 1e3:.3f} |", flush=True)
+            except Exception as e:  # noqa: BLE001 — e.g. VMEM overflow combos
+                print(f"| {q_blk} | {p_blk} | FAILED {type(e).__name__} |",
+                      flush=True)
+        best = min((r for r in results if r["shape"] == label),
+                   key=lambda r: r["ms"], default=None)
+        if best:
+            print(f"best for {label}: q_blk={best['q_blk']} "
+                  f"p_blk_target={best['p_blk_target']} ({best['ms']:.3f} ms)")
+    print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
