@@ -207,12 +207,20 @@ def load_params_npz(path) -> Dict[str, dict]:
 
 def detect_format(path) -> str:
     """'torch' (.pth/.pt or torch-named npz), 'tensorpack' (reference npz),
-    or 'native' (raft-tpu npz)."""
+    'trainstate' (a training-loop checkpoint: full TrainState with path-named
+    leaves), or 'native' (params-only raft-tpu npz)."""
     spath = str(path)
     if spath.endswith((".pth", ".pt")):
         return "torch"
     with np.load(spath) as data:
         names = list(data.files)
+    if "step" in names and any(n.startswith("params/") for n in names):
+        return "trainstate"
+    if names and all(n.startswith("leaf_") for n in names):
+        raise ValueError(
+            f"{path} is a positional (pre-path-naming) TrainState "
+            f"checkpoint; it can only be restored by the training loop "
+            f"(resume), or re-saved by it in the current format")
     if any("." in n and "/" not in n for n in names):
         return "torch"
     leaves = {n.split("/")[-1] for n in names}
@@ -221,13 +229,37 @@ def detect_format(path) -> str:
     return "native"
 
 
+def from_train_checkpoint(path) -> Dict[str, dict]:
+    """Extract inference-ready full params (trainable + BN running stats)
+    from a training-loop checkpoint (training/checkpoint.py path-named
+    TrainState npz) — train then infer with the very file the loop wrote,
+    the journey the reference never supported in either direction."""
+    from ..training.state import merge_bn_state
+    params: Dict[str, dict] = {}
+    bn: Dict[str, dict] = {}
+    with np.load(str(path)) as data:
+        for name in data.files:
+            parts = name.split("/")
+            if parts[0] == "params" and len(parts) > 1:
+                _set_path(params, parts[1:-1], parts[-1], data[name])
+            elif parts[0] == "bn_state" and len(parts) > 1:
+                _set_path(bn, parts[1:-1], parts[-1], data[name])
+    if not params:
+        raise ValueError(f"{path} contains no params/ leaves")
+    return merge_bn_state(params, bn)
+
+
 def load_checkpoint_auto(path) -> Dict[str, dict]:
-    """Load any supported checkpoint: torch .pth, reference/tensorpack or
-    native .npz.  Dispatch: .pth -> torch loader; npz with '.'-dotted torch
-    names -> torch map; npz with W/'mean/EMA' leaves -> tensorpack map;
-    npz with w/gamma leaves -> native."""
+    """Load any supported checkpoint: torch .pth, reference/tensorpack npz,
+    native params npz, or a training-loop TrainState checkpoint.  Dispatch:
+    .pth -> torch loader; npz with '.'-dotted torch names -> torch map; npz
+    with W/'mean/EMA' leaves -> tensorpack map; npz with step + params/
+    leaves -> TrainState params extraction; npz with w/gamma leaves ->
+    native."""
     spath = str(path)
     fmt = detect_format(spath)
+    if fmt == "trainstate":
+        return from_train_checkpoint(spath)
     if fmt == "torch":
         if spath.endswith((".pth", ".pt")):
             import torch

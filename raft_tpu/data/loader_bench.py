@@ -39,8 +39,11 @@ def run(samples: int = 48, workers=(2, 4, 8), crop=(368, 496)) -> dict:
     for w in workers:
         loader = MPSampleLoader(ds, num_workers=w, seed=0)
         try:
+            # warmup must drain the pre-filled result buffer (queue depth
+            # 2*w) or the buffered samples arrive instantly and inflate the
+            # measured steady-state rate
             results[f"mp{w}_pairs_per_s"] = round(
-                measure_rate(iter(loader), samples), 2)
+                measure_rate(iter(loader), samples, warmup=2 * w + 2), 2)
         finally:
             loader.close()
     return results

@@ -6,6 +6,14 @@ whole TrainState (step, trainable params, BN stats, optimizer state) is
 serialized; restore takes a template state (created fresh from the same
 configs) so arbitrary optax pytrees round-trip exactly.  Single-file npz —
 multi-host safe (only process 0 writes; everyone restores identically).
+
+Leaves are keyed by their tree PATH ('params/fnet/conv1/w',
+'opt_state/1/0/mu/...'), which makes two journeys work without a template
+sidecar: restore errors name the exact diverging leaf, and the inference CLI
+can extract ``params``+``bn_state`` straight out of a training checkpoint
+(convert.load_checkpoint_auto) — train then infer with the file the loop
+wrote, no export step required.  Checkpoints from before this scheme
+(positional ``leaf_00042`` keys) still restore.
 """
 
 from __future__ import annotations
@@ -14,10 +22,33 @@ import os
 import re
 import tempfile
 from pathlib import Path
-from typing import Optional
+from typing import Dict, Optional
 
 import jax
 import numpy as np
+
+
+def _path_str(keypath) -> str:
+    """Stringify a jax key path: DictKey 'name', GetAttrKey '.attr',
+    SequenceKey '[i]' all become '/'-joined segments."""
+    parts = []
+    for k in keypath:
+        if hasattr(k, "key"):          # DictKey / FlattenedIndexKey
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):       # GetAttrKey (NamedTuple fields)
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):        # SequenceKey
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _named_leaves(state) -> Dict[str, object]:
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    named = {_path_str(kp): leaf for kp, leaf in flat}
+    assert len(named) == len(flat), "leaf path collision"
+    return named
 
 
 def save_checkpoint(path, state, overwrite: bool = True) -> None:
@@ -25,8 +56,7 @@ def save_checkpoint(path, state, overwrite: bool = True) -> None:
     path = Path(path)
     if path.exists() and not overwrite:
         raise FileExistsError(path)
-    leaves = jax.tree.leaves(state)
-    arrays = {f"leaf_{i:05d}": np.asarray(x) for i, x in enumerate(leaves)}
+    arrays = {n: np.asarray(x) for n, x in _named_leaves(state).items()}
     path.parent.mkdir(parents=True, exist_ok=True)
     # write-then-rename so a crash never leaves a torn checkpoint
     fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".tmp.npz")
@@ -39,25 +69,42 @@ def save_checkpoint(path, state, overwrite: bool = True) -> None:
             os.unlink(tmp)
 
 
+def _restore_leaf(arr: np.ndarray, template_leaf, name: str, path) -> object:
+    want = np.shape(template_leaf)
+    if tuple(arr.shape) != tuple(want):
+        raise ValueError(f"{path}: leaf {name} shape {arr.shape} != "
+                         f"template {want}")
+    return (jax.numpy.asarray(arr) if hasattr(template_leaf, "dtype")
+            else arr.item() if arr.ndim == 0 else arr)
+
+
 def restore_checkpoint(path, template):
-    """Restore into the structure of ``template`` (a freshly-created state)."""
-    leaves, treedef = jax.tree.flatten(template)
+    """Restore into the structure of ``template`` (a freshly-created state).
+    Leaves are matched by tree path; pre-naming positional checkpoints
+    (``leaf_00042`` keys) are matched by flatten order."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
     with np.load(path) as data:
-        names = sorted(data.files)
-        if len(names) != len(leaves):
-            raise ValueError(
-                f"checkpoint {path} has {len(names)} leaves, template has "
-                f"{len(leaves)} — configs differ from the saved run")
-        restored = []
-        for name, leaf in zip(names, leaves):
-            arr = data[name]
-            want = np.shape(leaf)
-            if tuple(arr.shape) != tuple(want):
-                raise ValueError(f"{path}: leaf {name} shape {arr.shape} != "
-                                 f"template {want}")
-            restored.append(jax.numpy.asarray(arr) if hasattr(leaf, "dtype")
-                            else arr.item() if arr.ndim == 0 else arr)
-    return jax.tree.unflatten(treedef, restored)
+        names = set(data.files)
+        if names and all(re.fullmatch(r"leaf_\d+", n) for n in names):
+            ordered = sorted(names)
+            if len(ordered) != len(flat):
+                raise ValueError(
+                    f"checkpoint {path} has {len(ordered)} leaves, template "
+                    f"has {len(flat)} — configs differ from the saved run")
+            restored = [_restore_leaf(data[n], leaf, n, path)
+                        for n, (_, leaf) in zip(ordered, flat)]
+        else:
+            want = {_path_str(kp) for kp, _ in flat}
+            if names != want:
+                raise ValueError(
+                    f"checkpoint {path} does not match the template: "
+                    f"missing={sorted(want - names)[:8]} "
+                    f"extra={sorted(names - want)[:8]} — configs differ "
+                    f"from the saved run")
+            restored = [_restore_leaf(data[_path_str(kp)], leaf,
+                                      _path_str(kp), path)
+                        for kp, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, restored)
 
 
 def restore_checkpoint_compat(path, template):
@@ -71,6 +118,15 @@ def restore_checkpoint_compat(path, template):
     except ValueError:
         opt = getattr(template, "opt_state", None)
         if type(opt).__name__ != "ApplyIfFiniteState":
+            raise
+        with np.load(path) as data:
+            names = list(data.files)
+        positional = bool(names) and all(n.startswith("leaf_") for n in names)
+        has_wrapper = any(n.startswith("opt_state/notfinite_count")
+                          for n in names)
+        if has_wrapper and not positional:
+            # the checkpoint DOES carry the wrapper — the mismatch is a real
+            # config divergence; the original error names the exact leaf
             raise
         inner_template = template._replace(opt_state=opt.inner_state)
         restored = restore_checkpoint(path, inner_template)

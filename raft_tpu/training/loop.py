@@ -159,7 +159,8 @@ def _save_if_finite(path: Path, state: TrainState, log_fn, final: bool = False):
     slipped through (apply_if_finite passes through after its error budget)
     would later be resumed as the 'last good' state."""
     host_state = jax.device_get(state)
-    bad = [() for x in jax.tree.leaves(host_state.params)
+    bad = [() for x in (jax.tree.leaves(host_state.params)
+                        + jax.tree.leaves(host_state.bn_state))
            if not np.isfinite(np.asarray(x)).all()]
     if bad:
         log_fn(f"[train] NOT saving {path}: {len(bad)} param tensor(s) "
@@ -189,6 +190,7 @@ def train_cli(args, config: RAFTConfig) -> int:
         overrides.setdefault("ckpt_every", 100)
     tconfig = TrainConfig(**overrides)
 
+    mp_loader = None
     if args.data or args.dataset == "synthetic":
         from ..data.datasets import make_training_dataset
         ds = make_training_dataset(args.dataset, args.data, tconfig.image_size)
@@ -196,8 +198,9 @@ def train_cli(args, config: RAFTConfig) -> int:
         workers = getattr(args, "workers", 0)
         if workers >= 1:
             from ..data.mp_loader import MPSampleLoader
-            sample_iter = MPSampleLoader(ds, num_workers=workers,
-                                         seed=tconfig.seed)
+            mp_loader = MPSampleLoader(ds, num_workers=workers,
+                                       seed=tconfig.seed)
+            sample_iter = iter(mp_loader)
             print(f"[train] {workers} decode/augment worker processes")
         else:
             sample_iter = ds.sample_iter(seed=tconfig.seed)
@@ -209,8 +212,14 @@ def train_cli(args, config: RAFTConfig) -> int:
         batch_iter = PrefetchLoader(synthetic_batches(tconfig.batch_size, size))
 
     ckpt_dir = str(Path(args.out) / tconfig.ckpt_dir)
-    train(config, tconfig, batch_iter, ckpt_dir=ckpt_dir,
-          trace_dir=getattr(args, "trace", None))
+    try:
+        train(config, tconfig, batch_iter, ckpt_dir=ckpt_dir,
+              trace_dir=getattr(args, "trace", None))
+    finally:
+        if mp_loader is not None:
+            # reap worker processes + feeder even when train() raises (e.g.
+            # the halt_on_nonfinite FloatingPointError)
+            mp_loader.close()
 
     metrics_path = Path(ckpt_dir) / "metrics.jsonl"
     if metrics_path.exists():

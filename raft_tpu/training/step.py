@@ -48,6 +48,16 @@ def make_train_step(config: RAFTConfig, tconfig: TrainConfig,
         if axis_name is not None:
             grads = jax.lax.pmean(grads, axis_name)
             metrics = jax.lax.pmean(metrics, axis_name)
+        if tconfig.skip_nonfinite_updates:
+            # failure containment must cover BN running stats too: the
+            # optimizer (optax.apply_if_finite, gated on the same flag) only
+            # zeroes the param update on a poisoned batch — the forward's NaN
+            # batch statistics would still be adopted here and silently
+            # persist into every later checkpoint
+            finite = jnp.all(jnp.asarray(
+                [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]))
+            new_bn = jax.tree.map(lambda n, o: jnp.where(finite, n, o),
+                                  new_bn, state.bn_state)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_trainable = optax.apply_updates(state.params, updates)
         metrics["grad_norm"] = optax.global_norm(grads)

@@ -153,6 +153,53 @@ def test_trained_step_improves_epe_vs_init():
     assert float(m["epe"]) < float(m0["epe"]), (float(m0["epe"]), float(m["epe"]))
 
 
+def test_checkpoint_positional_backcompat(tmp_path):
+    """Checkpoints written by the old positional scheme (leaf_00042 keys)
+    must still restore by flatten order."""
+    config = RAFTConfig.small_model(iters=2)
+    tconfig = TrainConfig(num_steps=10, lr=1e-4, schedule="constant")
+    tx = make_optimizer(tconfig)
+    state = TrainState.create(init_raft(jax.random.PRNGKey(0), config), tx)
+    leaves = jax.tree.leaves(jax.device_get(state))
+    p = tmp_path / "ckpt_0.npz"
+    np.savez(p, **{f"leaf_{i:05d}": np.asarray(x)
+                   for i, x in enumerate(leaves)})
+    template = TrainState.create(init_raft(jax.random.PRNGKey(7), config), tx)
+    restored = restore_checkpoint(p, template)
+    for a, b in zip(leaves, jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_train_checkpoint_loads_for_inference(tmp_path):
+    """The train->infer journey: the npz the training loop writes must load
+    through the CLI's checkpoint path (params + BN stats extracted) and run
+    the forward, matching the in-memory full_params exactly."""
+    from raft_tpu.convert import load_checkpoint_auto
+    from raft_tpu.convert.weights import detect_format
+    from raft_tpu.models.raft import make_inference_fn
+
+    config = RAFTConfig.full(iters=2)    # full: has BN state to extract
+    tconfig = TrainConfig(num_steps=10, lr=1e-4, schedule="constant")
+    tx = make_optimizer(tconfig)
+    state = TrainState.create(init_raft(jax.random.PRNGKey(0), config), tx)
+    step = jax.jit(make_train_step(config, tconfig, tx))
+    state, _ = step(state, _tiny_batch(), jax.random.PRNGKey(1))
+    p = tmp_path / "ckpt_1.npz"
+    save_checkpoint(p, jax.device_get(state))
+
+    assert detect_format(p) == "trainstate"
+    params = load_checkpoint_auto(p)
+    expect = jax.device_get(state.full_params())
+    assert jax.tree.structure(params) == jax.tree.structure(expect)
+    for a, b in zip(jax.tree.leaves(expect), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    im = jnp.zeros((1, 32, 48, 3), jnp.float32)
+    flow = jax.jit(make_inference_fn(config))(
+        jax.tree.map(jnp.asarray, params), im, im)
+    assert flow.shape == (1, 32, 48, 2)
+    assert bool(jnp.isfinite(flow).all())
+
+
 def test_restore_compat_pre_apply_if_finite_checkpoint(tmp_path):
     """Checkpoints saved before the optimizer grew the apply_if_finite
     wrapper must still restore (inner opt state recovered, fresh counters)."""
@@ -182,6 +229,16 @@ def test_restore_compat_pre_apply_if_finite_checkpoint(tmp_path):
     step2 = jax.jit(make_train_step(config, new_tc, new_tx))
     _, m = step2(restored, _tiny_batch(), jax.random.PRNGKey(2))
     assert np.isfinite(float(m["loss"]))
+
+    # a checkpoint that DOES carry the wrapper but diverges elsewhere must
+    # surface the original precise error, not a phantom wrapper retry
+    p2 = tmp_path / "ckpt_wrapped.npz"
+    save_checkpoint(p2, jax.device_get(
+        TrainState.create(init_raft(jax.random.PRNGKey(0), config), new_tx)))
+    wrong = TrainState.create(
+        init_raft(jax.random.PRNGKey(0), RAFTConfig.full(iters=2)), new_tx)
+    with pytest.raises(ValueError, match="configs differ"):
+        restore_checkpoint_compat(p2, wrong)
 
 
 def test_checkpoint_skipped_when_params_nonfinite(tmp_path):
@@ -230,9 +287,12 @@ def test_metrics_stream_truncated_for_fresh_run(tmp_path):
 
 
 def test_nonfinite_grads_skipped():
-    """Failure containment: a poisoned batch (NaN pixels) must leave params
-    and optimizer moments untouched; the next clean batch updates normally."""
-    config = RAFTConfig.small_model(iters=2)
+    """Failure containment: a poisoned batch (NaN pixels) must leave params,
+    optimizer moments AND BN running stats untouched; the next clean batch
+    updates normally.  (Full model: it has BN state, which apply_if_finite
+    alone would not protect — the forward's NaN batch statistics must not be
+    adopted.)"""
+    config = RAFTConfig.full(iters=2)
     tconfig = TrainConfig(num_steps=10, lr=1e-4, schedule="constant")
     assert tconfig.skip_nonfinite_updates   # default on
     tx = make_optimizer(tconfig)
@@ -244,11 +304,18 @@ def test_nonfinite_grads_skipped():
     poisoned = clean._replace(
         image1=clean.image1.at[0, 0, 0, 0].set(jnp.nan))
     before = jax.tree.map(np.asarray, state.params)
+    bn_before = jax.tree.map(np.asarray, state.bn_state)
     state, metrics = step(state, poisoned, rng)
     assert not np.isfinite(float(metrics["loss"]))
     after = jax.tree.map(np.asarray, state.params)
     for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
         np.testing.assert_array_equal(a, b)
+    assert jax.tree.leaves(bn_before)   # full model really has BN state
+    for a, b in zip(jax.tree.leaves(bn_before),
+                    jax.tree.leaves(jax.tree.map(np.asarray, state.bn_state))):
+        np.testing.assert_array_equal(a, b)
+    assert np.isfinite(np.concatenate(
+        [np.ravel(x) for x in jax.tree.leaves(state.bn_state)])).all()
 
     state, metrics = step(state, clean, rng)
     assert np.isfinite(float(metrics["loss"]))

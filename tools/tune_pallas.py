@@ -43,6 +43,10 @@ def main() -> int:
     p.add_argument("--quick", action="store_true", help="fewer combos/reps")
     p.add_argument("--radius", type=int, default=4)
     p.add_argument("--levels", type=int, default=4)
+    p.add_argument("--precision", default="highest",
+                   choices=["highest", "default"],
+                   help="corr-matmul precision to tune for ('default' = bf16 "
+                        "MXU inputs, the bench winner's setting)")
     args = p.parse_args()
 
     import jax
@@ -57,7 +61,9 @@ def main() -> int:
     from raft_tpu.ops.corr_pallas import _fused_lookup_impl
 
     dev = jax.devices()[0]
-    print(f"# device: {dev.device_kind}")
+    prec = (jax.lax.Precision.HIGHEST if args.precision == "highest"
+            else jax.lax.Precision.DEFAULT)
+    print(f"# device: {dev.device_kind}  corr precision: {args.precision}")
 
     # (label, B, full-res H, W); fmaps are at os=8, C=256 (full model)
     shapes = [("eval 1x432x1024", 1, 432, 1024),
@@ -82,7 +88,7 @@ def main() -> int:
         for q_blk, p_blk in itertools.product(q_blks, p_blks):
             fn = jax.jit(functools.partial(
                 _fused_lookup_impl, radius=args.radius, q_blk=q_blk,
-                p_blk_target=p_blk, interpret=False))
+                p_blk_target=p_blk, interpret=False, corr_precision=prec))
             try:
                 dt = _measure(fn, (fmap1, f2_levels, coords),
                               reps=8 if args.quick else 20)
