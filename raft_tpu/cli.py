@@ -61,6 +61,10 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data", default=None, help="dataset root directory")
     p.add_argument("--dataset", default="sintel",
                    choices=["sintel", "chairs", "things", "kitti", "synthetic"])
+    p.add_argument("--bucket", type=int, default=None,
+                   help="val-mode resolution bucket (pad H,W to this "
+                        "multiple; default: 8, the InputPadder protocol, or "
+                        "64 for kitti's per-image sizes)")
     p.add_argument("--demo-train", action="store_true",
                    help="shortcut: train raft-small on the procedural "
                         "synthetic-flow dataset (no --data needed) for a few "

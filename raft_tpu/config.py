@@ -53,6 +53,11 @@ class RAFTConfig:
     # table in TUNING.md — not guesses.
     pallas_q_blk: int = 128
     pallas_p_blk: int = 4096
+    # Window-lookup formulation inside the fused kernel: 'matmul' (batched
+    # one-hot dot_generals) or 'vpu' (broadcast-multiply-reduce).  Identical
+    # values; relative speed is hardware-dependent (tools/tune_pallas.py
+    # --style sweeps it).
+    pallas_lookup_style: str = "matmul"
     # Compute dtype for conv/matmul-heavy paths ('float32' or 'bfloat16');
     # the correlation itself always accumulates in float32.
     compute_dtype: str = "float32"

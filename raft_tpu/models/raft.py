@@ -165,7 +165,8 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                                    config.corr_radius,
                                    corr_precision=corr_prec,
                                    q_blk=config.pallas_q_blk,
-                                   p_blk_target=config.pallas_p_blk)
+                                   p_blk_target=config.pallas_p_blk,
+                                   lookup_style=config.pallas_lookup_style)
     else:
         raise ValueError(config.corr_impl)
 

@@ -54,8 +54,15 @@ def _use_interpret() -> bool:
 
 def _level_kernel(f1_ref, coords_ref, f2_ref, out_ref, *, level_scale: float,
                   corr_scale: float, radius: int, h2_blk: int, w2: int,
-                  corr_precision):
-    """One (batch, query-block, p-block) program: corr tile + window lookup."""
+                  corr_precision, lookup_style: str = "matmul"):
+    """One (batch, query-block, p-block) program: corr tile + window lookup.
+
+    ``lookup_style``: how the separable one-hot interpolation contracts —
+    'matmul' (per-query batched dot_generals) or 'vpu' (broadcast-multiply-
+    reduce; per-query matmuls are tiny [n,h2_blk]x[h2_blk,W2] slivers that
+    Mosaic serializes over the T batch dim, so elementwise VPU work can win).
+    Both produce identical values.
+    """
     n = 2 * radius + 1
     k = pl.program_id(2)
     f1 = f1_ref[0]                                   # [T, C]
@@ -90,17 +97,24 @@ def _level_kernel(f1_ref, coords_ref, f2_ref, out_ref, *, level_scale: float,
     a_x = (jnp.where(w_ids == tx, 1.0 - fx, 0.0)
            + jnp.where(w_ids == tx + 1, fx, 0.0))
 
-    # interpolation matmuls always run at HIGHEST precision: the bilinear
-    # weights (1-f, f) must not be rounded to bf16 (subpixel flow accuracy),
-    # and these dots are tiny next to the corr matmul.
-    win_y = jax.lax.dot_general(                      # [T, n(y), W2]
-        a_y, corr3, (((2,), (1,)), ((0,), (0,))),
-        precision=jax.lax.Precision.HIGHEST,
-        preferred_element_type=jnp.float32)
-    win = jax.lax.dot_general(                        # [T, n(x), n(y)]
-        a_x, win_y, (((2,), (2,)), ((0,), (0,))),
-        precision=jax.lax.Precision.HIGHEST,
-        preferred_element_type=jnp.float32)
+    if lookup_style == "vpu":
+        # win_y[t,j,w] = sum_h a_y[t,j,h] * corr3[t,h,w]; the f32 multiply
+        # keeps the exact bilinear weights (same numerics as the HIGHEST-
+        # precision dots below), and Mosaic fuses multiply into reduce
+        win_y = jnp.sum(a_y[:, :, :, None] * corr3[:, None, :, :], axis=2)
+        win = jnp.sum(a_x[:, :, None, :] * win_y[:, None, :, :], axis=3)
+    else:
+        # interpolation matmuls always run at HIGHEST precision: the bilinear
+        # weights (1-f, f) must not be rounded to bf16 (subpixel flow
+        # accuracy), and these dots are tiny next to the corr matmul.
+        win_y = jax.lax.dot_general(                  # [T, n(y), W2]
+            a_y, corr3, (((2,), (1,)), ((0,), (0,))),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+        win = jax.lax.dot_general(                    # [T, n(x), n(y)]
+            a_x, win_y, (((2,), (2,)), ((0,), (0,))),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
     # x-offset-major [T, n, n]; the flatten to n^2 happens outside the kernel
     # (Mosaic has no shape cast merging two unaligned minor dims)
 
@@ -116,7 +130,8 @@ def _level_kernel(f1_ref, coords_ref, f2_ref, out_ref, *, level_scale: float,
 def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
                   radius: int, level: int, *, q_blk: int,
                   p_blk_target: int, interpret: bool,
-                  corr_precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+                  corr_precision=jax.lax.Precision.HIGHEST,
+                  lookup_style: str = "matmul") -> jax.Array:
     """f1 [B,Q,C], f2_level [B,H2,W2,C], coords [B,Q,2] -> [B,Q,(2r+1)^2]."""
     B, Q, C = f1.shape
     _, H2, W2, _ = f2_level.shape
@@ -150,7 +165,7 @@ def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
     kernel = functools.partial(
         _level_kernel, level_scale=1.0 / (2.0 ** level),
         corr_scale=1.0 / (C ** 0.5), radius=radius, h2_blk=h2_blk, w2=W2p,
-        corr_precision=corr_precision)
+        corr_precision=corr_precision, lookup_style=lookup_style)
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -172,26 +187,34 @@ def _fused_lookup_impl(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
                        coords: jax.Array, radius: int,
                        q_blk: int = 128, p_blk_target: int = 4096,
                        interpret: Optional[bool] = None,
-                       corr_precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+                       corr_precision=jax.lax.Precision.HIGHEST,
+                       lookup_style: str = "matmul") -> jax.Array:
     B, H, W, C = fmap1.shape
     Q = H * W
+    if lookup_style not in ("matmul", "vpu"):
+        # same silent-fallback hazard as corr_lookup/corr_precision: a typo
+        # must not quietly run the other formulation
+        raise ValueError(f"lookup_style must be 'matmul' or 'vpu', "
+                         f"got {lookup_style!r}")
     interp = _use_interpret() if interpret is None else interpret
     f1 = fmap1.reshape(B, Q, C)
     cf = coords.reshape(B, Q, 2)
     outs = [
         _lookup_level(f1, f2l, cf, radius, i, q_blk=q_blk,
                       p_blk_target=p_blk_target, interpret=interp,
-                      corr_precision=corr_precision)
+                      corr_precision=corr_precision,
+                      lookup_style=lookup_style)
         for i, f2l in enumerate(f2_levels)
     ]
     return jnp.concatenate(outs, axis=-1).reshape(B, H, W, -1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def fused_lookup(fmap1: jax.Array, f2_levels: Tuple[jax.Array, ...],
                  coords: jax.Array, radius: int,
                  corr_precision=jax.lax.Precision.HIGHEST,
-                 q_blk: int = 128, p_blk_target: int = 4096) -> jax.Array:
+                 q_blk: int = 128, p_blk_target: int = 4096,
+                 lookup_style: str = "matmul") -> jax.Array:
     """Pallas-fused correlation lookup.
 
     fmap1 [B,H,W,C], f2_levels tuple of [B,H/2^i,W/2^i,C], coords [B,H,W,2]
@@ -199,19 +222,21 @@ def fused_lookup(fmap1: jax.Array, f2_levels: Tuple[jax.Array, ...],
     """
     return _fused_lookup_impl(fmap1, f2_levels, coords, radius,
                               q_blk=q_blk, p_blk_target=p_blk_target,
-                              corr_precision=corr_precision)
+                              corr_precision=corr_precision,
+                              lookup_style=lookup_style)
 
 
 def _fused_lookup_fwd(fmap1, f2_levels, coords, radius, corr_precision,
-                      q_blk, p_blk_target):
+                      q_blk, p_blk_target, lookup_style):
     return _fused_lookup_impl(fmap1, f2_levels, coords, radius,
                               q_blk=q_blk, p_blk_target=p_blk_target,
-                              corr_precision=corr_precision), (
+                              corr_precision=corr_precision,
+                              lookup_style=lookup_style), (
         fmap1, f2_levels, coords)
 
 
 def _fused_lookup_bwd(radius, corr_precision, q_blk, p_blk_target,
-                      residuals, g):
+                      lookup_style, residuals, g):
     # gradients via the matmul-only XLA twin (no gathers in the backward);
     # the configured corr precision applies to the backward matmuls too —
     # 'highest' must not silently degrade to bf16 MXU inputs in training
@@ -228,7 +253,8 @@ fused_lookup.defvjp(_fused_lookup_fwd, _fused_lookup_bwd)
 
 def make_fused_lookup(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
                       radius: int, corr_precision="highest",
-                      q_blk: int = 128, p_blk_target: int = 4096):
+                      q_blk: int = 128, p_blk_target: int = 4096,
+                      lookup_style: str = "matmul"):
     """Build the per-iteration lookup closure used by models/raft.py.
 
     Pools the fmap2 pyramid once; each GRU iteration then runs the fused
@@ -246,6 +272,6 @@ def make_fused_lookup(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
 
     def lookup(coords: jax.Array) -> jax.Array:
         return fused_lookup(fmap1, f2_levels, coords, radius, prec,
-                            q_blk, p_blk_target)
+                            q_blk, p_blk_target, lookup_style)
 
     return lookup

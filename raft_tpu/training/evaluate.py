@@ -102,6 +102,12 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
     else:
         print(f"ERROR: no val handler for dataset {args.dataset!r}")
         return 2
+    if getattr(args, "bucket", None) is not None:
+        if args.bucket < 8 or args.bucket % 8:
+            print(f"ERROR: --bucket must be a positive multiple of 8, "
+                  f"got {args.bucket}")
+            return 2
+        bucket = args.bucket
     metrics = evaluate_dataset(params, config, ds, iters=args.iters,
                                pad_mode=pad_mode, bucket=bucket)
     name = f"{args.dataset} ({'small' if args.small else 'full'})"

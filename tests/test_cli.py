@@ -32,9 +32,10 @@ def test_mode_flops_reports(capsys):
     assert 0.9e6 < n < 1.1e6, n
 
 
-def test_demo_train_smoke(tmp_path):
-    """--demo-train end to end (2 steps, tiny overrides): synthetic dataset,
-    training loop, checkpoint + metrics stream under --out."""
+def test_demo_train_then_val_journey(tmp_path, capsys):
+    """The flagship journey end to end: --demo-train (2 tiny steps) writes a
+    checkpoint + metrics stream, then val --load <that checkpoint> evaluates
+    it on the held-out synthetic split — no export step in between."""
     rc = cli.main(["--demo-train", "--num-steps", "2", "--iters", "2",
                    "--batch", "2", "--train-size", "48", "64",
                    "--out", str(tmp_path)])
@@ -44,4 +45,13 @@ def test_demo_train_smoke(tmp_path):
                metrics.read_text().splitlines() if ln.strip()]
     assert records and records[-1]["step"] == 1
     assert np.isfinite(records[-1]["epe"])
-    assert (tmp_path / "checkpoints" / "ckpt_2.npz").exists()
+    ckpt = tmp_path / "checkpoints" / "ckpt_2.npz"
+    assert ckpt.exists()
+
+    rc = cli.main(["-m", "val", "--dataset", "synthetic", "--small",
+                   "--iters", "2", "--train-size", "48", "64",
+                   "--load", str(ckpt)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "[val] synthetic" in out and "epe=" in out
+    assert f"loaded checkpoint from {ckpt}" in out

@@ -40,6 +40,24 @@ def test_matches_dense_oracle(B, H, W, C, levels, radius):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("B,H,W,C,levels,radius", [
+    (1, 16, 24, 32, 4, 4),
+    (2, 12, 16, 16, 3, 3),
+])
+def test_vpu_lookup_style_matches_dense_oracle(B, H, W, C, levels, radius):
+    """The broadcast-multiply-reduce lookup formulation (lookup_style='vpu',
+    the MXU-sliver-free variant for TPU) must match the dense oracle too."""
+    from raft_tpu.ops.corr_pallas import _fused_lookup_impl
+
+    fmap1, fmap2, coords = _random_case(jax.random.PRNGKey(3), B, H, W, C)
+    want = lookup_dense(build_pyramid(fmap1, fmap2, levels), coords, radius)
+    f2_levels = tuple(fmap2_pyramid(fmap2, levels))
+    got = _fused_lookup_impl(fmap1, f2_levels, coords, radius,
+                             lookup_style="vpu")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_integer_coords_and_oob_zeros_padding():
     """Exact-integer coords (fractional part 0) and windows fully/partially
     outside the map (zeros padding, reference utils.py:84-89 semantics via

@@ -47,6 +47,8 @@ def main() -> int:
                    choices=["highest", "default"],
                    help="corr-matmul precision to tune for ('default' = bf16 "
                         "MXU inputs, the bench winner's setting)")
+    p.add_argument("--style", default="matmul", choices=["matmul", "vpu"],
+                   help="window-lookup formulation inside the kernel")
     args = p.parse_args()
 
     import jax
@@ -63,7 +65,8 @@ def main() -> int:
     dev = jax.devices()[0]
     prec = (jax.lax.Precision.HIGHEST if args.precision == "highest"
             else jax.lax.Precision.DEFAULT)
-    print(f"# device: {dev.device_kind}  corr precision: {args.precision}")
+    print(f"# device: {dev.device_kind}  corr precision: {args.precision}  "
+          f"lookup style: {args.style}")
 
     # (label, B, full-res H, W); fmaps are at os=8, C=256 (full model)
     shapes = [("eval 1x432x1024", 1, 432, 1024),
@@ -88,7 +91,8 @@ def main() -> int:
         for q_blk, p_blk in itertools.product(q_blks, p_blks):
             fn = jax.jit(functools.partial(
                 _fused_lookup_impl, radius=args.radius, q_blk=q_blk,
-                p_blk_target=p_blk, interpret=False, corr_precision=prec))
+                p_blk_target=p_blk, interpret=False, corr_precision=prec,
+                lookup_style=args.style))
             try:
                 dt = _measure(fn, (fmap1, f2_levels, coords),
                               reps=8 if args.quick else 20)
