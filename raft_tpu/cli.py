@@ -44,7 +44,8 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--size", type=int, nargs=2, default=(432, 1024),
                    metavar=("H", "W"), help="inference resolution")
     p.add_argument("--batch", type=int, default=None,
-                   help="batch size (default 1; 4 under --demo-train)")
+                   help="batch size (default: 1 for test/export, the stage "
+                        "preset's batch for train, 4 under --demo-train)")
     p.add_argument("--corr-impl", default="dense",
                    choices=["dense", "blockwise", "pallas"])
     p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
@@ -242,7 +243,8 @@ def main(argv=None) -> int:
             args.iters = 8
         if args.batch is None:
             args.batch = 4
-    if args.batch is None:
+    if args.batch is None and args.mode != "train":
+        # train mode leaves None so the stage preset's batch size applies
         args.batch = 1
     if args.cpu:
         import jax

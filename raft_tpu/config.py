@@ -115,3 +115,27 @@ class TrainConfig:
     log_every: int = 100
     ckpt_every: int = 5000
     ckpt_dir: str = "checkpoints"
+
+    @staticmethod
+    def for_stage(stage: str, **overrides) -> "TrainConfig":
+        """Official RAFT curriculum presets (paper §4 / official repo
+        train_standard.sh): chairs -> things -> sintel/kitti finetune.
+        Explicit overrides win."""
+        presets = {
+            "chairs":    dict(num_steps=100_000, lr=4e-4, batch_size=10,
+                              image_size=(368, 496), weight_decay=1e-4),
+            "things":    dict(num_steps=100_000, lr=1.25e-4, batch_size=6,
+                              image_size=(400, 720), weight_decay=1e-4),
+            "sintel":    dict(num_steps=100_000, lr=1.25e-4, batch_size=6,
+                              image_size=(368, 768), weight_decay=1e-5,
+                              gamma=0.85),
+            "kitti":     dict(num_steps=50_000, lr=1e-4, batch_size=6,
+                              image_size=(288, 960), weight_decay=1e-5,
+                              gamma=0.85),
+            "synthetic": dict(image_size=(96, 128), log_every=10,
+                              ckpt_every=100),
+        }
+        if stage not in presets:
+            raise ValueError(f"unknown stage {stage!r}; "
+                             f"options: {sorted(presets)}")
+        return TrainConfig(**{**presets[stage], **overrides})

@@ -73,6 +73,12 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
 
 def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
     from ..data import datasets as D
+    if getattr(args, "bucket", None) is not None and (
+            args.bucket < 8 or args.bucket % 8):
+        # validate before the (slow) checkpoint load / dataset scan
+        print(f"ERROR: --bucket must be a positive multiple of 8, "
+              f"got {args.bucket}")
+        return 2
     params = load_params(args, config)
     bucket = 8
     if args.dataset == "synthetic":
@@ -103,10 +109,6 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
         print(f"ERROR: no val handler for dataset {args.dataset!r}")
         return 2
     if getattr(args, "bucket", None) is not None:
-        if args.bucket < 8 or args.bucket % 8:
-            print(f"ERROR: --bucket must be a positive multiple of 8, "
-                  f"got {args.bucket}")
-            return 2
         bucket = args.bucket
     metrics = evaluate_dataset(params, config, ds, iters=args.iters,
                                pad_mode=pad_mode, bucket=bucket)

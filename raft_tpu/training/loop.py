@@ -173,22 +173,18 @@ def _save_if_finite(path: Path, state: TrainState, log_fn, final: bool = False):
 def train_cli(args, config: RAFTConfig) -> int:
     from ..data.pipeline import PrefetchLoader, batched, synthetic_batches
 
-    overrides = {}
+    # stage presets carry the official curriculum hyperparameters (steps,
+    # lr, batch, crop, decay — TrainConfig.for_stage); explicit flags win
+    overrides = {"optimizer": args.optimizer}
     if args.num_steps is not None:
         overrides["num_steps"] = args.num_steps
     if args.lr is not None:
         overrides["lr"] = args.lr
-    overrides["optimizer"] = args.optimizer
-    overrides["batch_size"] = args.batch
+    if args.batch is not None:
+        overrides["batch_size"] = args.batch
     if getattr(args, "train_size", None):
         overrides["image_size"] = tuple(args.train_size)
-    if args.dataset == "synthetic":
-        # procedural data: small frames, tight logging so the EPE curve in
-        # metrics.jsonl is dense enough to read as trainability evidence
-        overrides.setdefault("image_size", (96, 128))
-        overrides.setdefault("log_every", 10)
-        overrides.setdefault("ckpt_every", 100)
-    tconfig = TrainConfig(**overrides)
+    tconfig = TrainConfig.for_stage(args.dataset, **overrides)
 
     mp_loader = None
     if args.data or args.dataset == "synthetic":

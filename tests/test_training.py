@@ -153,6 +153,20 @@ def test_trained_step_improves_epe_vs_init():
     assert float(m["epe"]) < float(m0["epe"]), (float(m0["epe"]), float(m["epe"]))
 
 
+def test_train_config_stage_presets():
+    """Official-curriculum presets resolve, overrides win, typos raise."""
+    chairs = TrainConfig.for_stage("chairs")
+    assert chairs.batch_size == 10 and chairs.lr == 4e-4
+    assert chairs.image_size == (368, 496)
+    kitti = TrainConfig.for_stage("kitti", lr=5e-5)
+    assert kitti.num_steps == 50_000 and kitti.gamma == 0.85
+    assert kitti.lr == 5e-5                      # explicit override wins
+    syn = TrainConfig.for_stage("synthetic")
+    assert syn.image_size == (96, 128) and syn.log_every == 10
+    with pytest.raises(ValueError, match="unknown stage"):
+        TrainConfig.for_stage("chiars")
+
+
 def test_checkpoint_positional_backcompat(tmp_path):
     """Checkpoints written by the old positional scheme (leaf_00042 keys)
     must still restore by flatten order."""
