@@ -106,6 +106,22 @@ def _init_device(force_cpu: bool, retries: int = 3):
                               f"({last}); ran on CPU at reduced size")
 
 
+def _cfg_for(name: str):
+    """Map a candidate name (bare, no '+bf16'/',bN' suffixes) to config."""
+    from raft_tpu.config import RAFTConfig
+
+    impl = ("pallas" if name.startswith("pallas")
+            else "dense" if name.startswith("dense")
+            else "blockwise" if name.startswith("blockwise") else name)
+    return RAFTConfig.full(
+        corr_impl=impl,
+        corr_precision=("default" if name.startswith("pallas-bf16corr")
+                        else "highest"),
+        corr_lookup="onehot" if name.endswith("-onehot") else "gather",
+        pallas_lookup_style="vpu" if name.endswith("-vpu") else "matmul",
+        compute_dtype="bfloat16")
+
+
 def _readback(x) -> float:
     """True synchronization: pull one scalar of the output back to host.
     (Under tunneled backends, block_until_ready alone has been observed to
@@ -230,21 +246,12 @@ def _run(args, t_start: float, result: dict) -> None:
     # candidate tuned configurations, best-known-first so a tight budget
     # still measures the likely winner; best one is the headline number
     candidates = ([args.impl] if args.impl
-                  else ["pallas-bf16corr", "pallas", "dense-onehot", "dense",
-                        "blockwise-onehot", "blockwise"])
+                  else ["pallas-bf16corr", "pallas-bf16corr-vpu", "pallas",
+                        "dense-onehot", "dense", "blockwise-onehot",
+                        "blockwise"])
     if jax.default_backend() != "tpu" and not args.impl:
         # off-TPU the Pallas kernel runs in interpret mode (test-only speed)
         candidates = [c for c in candidates if not c.startswith("pallas")]
-    def cfg_for(name: str):
-        """Map a candidate name (bare, no '+bf16'/',bN' suffixes) to config."""
-        impl = ("pallas" if name.startswith("pallas")
-                else "dense" if name.startswith("dense")
-                else "blockwise" if name.startswith("blockwise") else name)
-        return RAFTConfig.full(
-            corr_impl=impl,
-            corr_precision="default" if name == "pallas-bf16corr" else "highest",
-            corr_lookup="onehot" if name.endswith("-onehot") else "gather",
-            compute_dtype="bfloat16")
 
     best_name, best, best_mfu = None, -1.0, None
     for name in candidates:
@@ -252,7 +259,7 @@ def _run(args, t_start: float, result: dict) -> None:
             print(f"# budget exceeded; skipping {name}", file=sys.stderr)
             continue
         try:
-            tput, mfu = throughput(cfg_for(name), args.iters)
+            tput, mfu = throughput(_cfg_for(name), args.iters)
             print(f"# {name}+bf16: {tput:.3f} pairs/s"
                   + (f"  mfu={mfu:.3f}" if mfu else ""), file=sys.stderr)
             if tput > best:
@@ -264,7 +271,7 @@ def _run(args, t_start: float, result: dict) -> None:
     # capabilities the reference lacked, reference readme.md:13; larger
     # batches raise MXU utilization and pairs/sec/chip)
     if best_name is not None and B == 1:
-        cfg = cfg_for(best_name.split("+")[0])
+        cfg = _cfg_for(best_name.split("+")[0])
         for nb in (4, 8):
             if time.perf_counter() - t_start > args.budget:
                 print(f"# budget exceeded; skipping batch {nb}", file=sys.stderr)

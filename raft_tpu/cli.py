@@ -74,8 +74,9 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-steps", type=int, default=None)
     p.add_argument("--train-size", type=int, nargs=2, default=None,
                    metavar=("H", "W"),
-                   help="training crop size (default 368 496; "
-                        "--demo-train defaults to 96 128)")
+                   help="training crop size (default: the stage preset's "
+                        "crop, e.g. 368x496 chairs / 400x720 things; "
+                        "96x128 for synthetic)")
     p.add_argument("--workers", type=int, default=0,
                    help="decode/augment worker processes (0 = in-line in the "
                         "prefetch thread); the PrefetchDataZMQ analog")

@@ -132,8 +132,8 @@ class TrainConfig:
             "kitti":     dict(num_steps=50_000, lr=1e-4, batch_size=6,
                               image_size=(288, 960), weight_decay=1e-5,
                               gamma=0.85),
-            "synthetic": dict(image_size=(96, 128), log_every=10,
-                              ckpt_every=100),
+            "synthetic": dict(image_size=(96, 128), batch_size=4,
+                              log_every=10, ckpt_every=100),
         }
         if stage not in presets:
             raise ValueError(f"unknown stage {stage!r}; "

@@ -1,0 +1,62 @@
+"""Unit tests for the driver benchmark's candidate-config mapping — bench.py
+is the round's only perf artifact, so a silent mis-mapping (a candidate name
+measuring a different configuration than its label) must be caught in CI."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _cfg_for, _peak_flops
+
+
+@pytest.mark.parametrize("name,impl,precision,lookup,style", [
+    ("pallas-bf16corr",     "pallas",    "default", "gather", "matmul"),
+    ("pallas-bf16corr-vpu", "pallas",    "default", "gather", "vpu"),
+    ("pallas",              "pallas",    "highest", "gather", "matmul"),
+    ("dense-onehot",        "dense",     "highest", "onehot", "matmul"),
+    ("dense",               "dense",     "highest", "gather", "matmul"),
+    ("blockwise-onehot",    "blockwise", "highest", "onehot", "matmul"),
+    ("blockwise",           "blockwise", "highest", "gather", "matmul"),
+])
+def test_candidate_config_mapping(name, impl, precision, lookup, style):
+    cfg = _cfg_for(name)
+    assert cfg.corr_impl == impl
+    assert cfg.corr_precision == precision
+    assert cfg.corr_lookup == lookup
+    assert cfg.pallas_lookup_style == style
+    assert cfg.compute_dtype == "bfloat16"
+    assert not cfg.small
+
+
+def test_candidate_configs_construct_valid_models():
+    """Every candidate's config must pass the model's validation layer (the
+    forward raises on unknown corr_lookup/corr_precision/lookup_style)."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.models import init_raft
+    from raft_tpu.models.raft import raft_forward
+
+    # one tiny forward per distinct (impl, lookup, style) triple; pallas
+    # runs in interpret mode on CPU, so keep it to a single iteration
+    seen = set()
+    for name in ("pallas-bf16corr-vpu", "dense-onehot", "blockwise"):
+        cfg = _cfg_for(name)
+        key = (cfg.corr_impl, cfg.corr_lookup, cfg.pallas_lookup_style)
+        assert key not in seen
+        seen.add(key)
+        import dataclasses
+        cfg = dataclasses.replace(cfg, iters=1, corr_levels=2)
+        params = init_raft(jax.random.PRNGKey(0), cfg)
+        im = jnp.zeros((1, 16, 24, 3), jnp.float32)
+        out, _ = raft_forward(params, im, im, cfg)
+        assert out.flow.shape == (1, 16, 24, 2)
+
+
+def test_peak_flops_table():
+    assert _peak_flops("TPU v5 lite") == pytest.approx(197e12)
+    assert _peak_flops("TPU v4") == pytest.approx(275e12)
+    assert _peak_flops("cpu") is None
