@@ -63,6 +63,10 @@ class RAFTConfig:
     compute_dtype: str = "float32"
     # Rematerialize each GRU iteration during backprop (memory/FLOPs trade).
     remat_iters: bool = True
+    # lax.scan unroll factor for the GRU iteration loop (1 = no unrolling).
+    # Unrolling lets XLA fuse/overlap across adjacent iterations at the cost
+    # of code size; measured on hardware before changing the default.
+    scan_unroll: int = 1
 
     @property
     def fnet_dim(self) -> int:

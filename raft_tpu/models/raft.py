@@ -205,7 +205,8 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
 
     mask0 = None if config.small else jnp.zeros((B, h, w, 64 * 9), cdt)
     (net, coords1, mask), ys = jax.lax.scan(
-        step, (net, coords1, mask0), None, length=iters)
+        step, (net, coords1, mask0), None, length=iters,
+        unroll=min(config.scan_unroll, iters))
 
     flow_lr = coords1 - coords0
     if all_flows:

@@ -143,3 +143,19 @@ def test_unknown_corr_lookup_rejected_all_impls(impl):
     im = jnp.zeros((1, 32, 32, 3))
     with pytest.raises(ValueError, match="corr_lookup"):
         raft_forward(params, im, im, cfg)
+
+
+def test_scan_unroll_equivalence():
+    """scan_unroll is a pure scheduling knob: outputs must match unroll=1."""
+    base = RAFTConfig.full(iters=4)
+    unrolled = RAFTConfig.full(iters=4, scan_unroll=2)
+    params, im1, im2 = _params_and_images(base)
+    out_a, _ = raft_forward(params, im1, im2, base)
+    out_b, _ = raft_forward(params, im1, im2, unrolled)
+    scale = np.abs(np.asarray(out_a.flow)).mean()
+    diff = np.abs(np.asarray(out_a.flow) - np.asarray(out_b.flow)).max()
+    assert diff / scale < 1e-4, (diff, scale)
+    # unroll larger than iters is clamped, not an error
+    clamped = RAFTConfig.full(iters=2, scan_unroll=8)
+    out_c, _ = raft_forward(params, im1, im2, clamped)
+    assert np.all(np.isfinite(np.asarray(out_c.flow)))
