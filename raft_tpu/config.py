@@ -58,6 +58,14 @@ class RAFTConfig:
     # values; relative speed is hardware-dependent (tools/tune_pallas.py
     # --style sweeps it).
     pallas_lookup_style: str = "matmul"
+    # Which f2 row-blocks each program grid visits: 'all' iterates every
+    # block (flash-style full pass), 'window' prefetches a per-query-block
+    # schedule of only the row-blocks its bilinear windows can touch —
+    # repeated schedule entries skip the DMA and the compute.  Identical
+    # values; 'window' wins when the lookup window covers a small fraction
+    # of the map (use a smaller pallas_p_blk, e.g. 1024, so blocks are fine
+    # enough to skip).
+    pallas_p_select: str = "all"
     # Compute dtype for conv/matmul-heavy paths ('float32' or 'bfloat16');
     # the correlation itself always accumulates in float32.
     compute_dtype: str = "float32"

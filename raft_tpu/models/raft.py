@@ -127,6 +127,8 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
         # quietly degrade the corr matmuls to bf16 MXU inputs
         raise ValueError(f"corr_precision must be 'highest' or 'default', "
                          f"got {config.corr_precision!r}")
+    if config.scan_unroll < 1:
+        raise ValueError(f"scan_unroll must be >= 1, got {config.scan_unroll}")
     corr_prec = (jax.lax.Precision.HIGHEST if config.corr_precision == "highest"
                  else jax.lax.Precision.DEFAULT)
 
@@ -166,7 +168,8 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                                    corr_precision=corr_prec,
                                    q_blk=config.pallas_q_blk,
                                    p_blk_target=config.pallas_p_blk,
-                                   lookup_style=config.pallas_lookup_style)
+                                   lookup_style=config.pallas_lookup_style,
+                                   p_select=config.pallas_p_select)
     else:
         raise ValueError(config.corr_impl)
 

@@ -40,6 +40,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from .corr import fmap2_pyramid, lookup_blockwise_onehot
 
@@ -52,10 +53,12 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _level_kernel(f1_ref, coords_ref, f2_ref, out_ref, *, level_scale: float,
-                  corr_scale: float, radius: int, h2_blk: int, w2: int,
-                  corr_precision, lookup_style: str = "matmul"):
-    """One (batch, query-block, p-block) program: corr tile + window lookup.
+def _window_body(sel, f1_ref, coords_ref, f2_ref, *, level_scale: float,
+                 corr_scale: float, radius: int, h2_blk: int, w2: int,
+                 corr_precision, lookup_style: str):
+    """Shared program body: corr tile against f2 row-block ``sel`` + the
+    separable one-hot window lookup.  Returns the [T, n, n] x-offset-major
+    window contribution of this row-block.
 
     ``lookup_style``: how the separable one-hot interpolation contracts —
     'matmul' (per-query batched dot_generals) or 'vpu' (broadcast-multiply-
@@ -64,7 +67,6 @@ def _level_kernel(f1_ref, coords_ref, f2_ref, out_ref, *, level_scale: float,
     Both produce identical values.
     """
     n = 2 * radius + 1
-    k = pl.program_id(2)
     f1 = f1_ref[0]                                   # [T, C]
     f2 = f2_ref[0]                                   # [Pblk, C]
     T = f1.shape[0]
@@ -85,7 +87,7 @@ def _level_kernel(f1_ref, coords_ref, f2_ref, out_ref, *, level_scale: float,
 
     # A_y [T, n, h2_blk]: rows of the bilinear window that land in this p-block
     h_ids = (jax.lax.broadcasted_iota(jnp.int32, (T, n, h2_blk), 2)
-             + k * h2_blk)
+             + sel * h2_blk)
     ty = iy0[:, None, None] + jax.lax.broadcasted_iota(
         jnp.int32, (T, n, h2_blk), 1)
     a_y = (jnp.where(h_ids == ty, 1.0 - fy, 0.0)
@@ -117,7 +119,10 @@ def _level_kernel(f1_ref, coords_ref, f2_ref, out_ref, *, level_scale: float,
             preferred_element_type=jnp.float32)
     # x-offset-major [T, n, n]; the flatten to n^2 happens outside the kernel
     # (Mosaic has no shape cast merging two unaligned minor dims)
+    return win
 
+
+def _accumulate(out_ref, win, k):
     @pl.when(k == 0)
     def _():
         out_ref[0] = win
@@ -127,11 +132,61 @@ def _level_kernel(f1_ref, coords_ref, f2_ref, out_ref, *, level_scale: float,
         out_ref[0] = out_ref[0] + win
 
 
+def _level_kernel(f1_ref, coords_ref, f2_ref, out_ref, **body_kw):
+    """One (batch, query-block, p-block) program: the k-th grid step visits
+    f2 row-block k (full pass over the map)."""
+    k = pl.program_id(2)
+    win = _window_body(k, f1_ref, coords_ref, f2_ref, **body_kw)
+    _accumulate(out_ref, win, k)
+
+
+def _window_kernel(S_ref, f1_ref, coords_ref, f2_ref, out_ref, **body_kw):
+    """Window-scheduled program: identical math to ``_level_kernel`` but the
+    k-th grid step visits f2 row-block ``S[b, j, k]`` instead of row-block
+    ``k``.  The schedule repeats its last needed block to fill the static
+    grid; a repeated index means the pipeline skips the DMA refetch and this
+    body skips the compute, so only row-blocks actually overlapped by the
+    query block's bilinear windows do work."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    k = pl.program_id(2)
+    sel = S_ref[b, j, k]
+    prev = S_ref[b, j, jnp.maximum(k - 1, 0)]
+
+    @pl.when((k == 0) | (sel != prev))
+    def _():
+        win = _window_body(sel, f1_ref, coords_ref, f2_ref, **body_kw)
+        _accumulate(out_ref, win, k)
+
+
+def _window_schedule(coords: jax.Array, level_scale: float, radius: int,
+                     T: int, h2_blk: int, H2: int, K: int) -> jax.Array:
+    """Per (batch, query-block) contiguous range of f2 row-blocks its bilinear
+    windows can touch, as a [B, Qb, K] block-index schedule.  Entries past
+    the needed range repeat the last needed block (skip marker).  Fully
+    out-of-map windows contribute zeros via the one-hot construction, so
+    pointing them at block 0 is safe."""
+    B, Qp, _ = coords.shape
+    n = 2 * radius + 1
+    cy = coords[..., 1] * level_scale                     # [B, Qp]
+    iy0 = jnp.floor(cy).astype(jnp.int32) - radius
+    iyb = iy0.reshape(B, Qp // T, T)
+    lo = iyb.min(axis=2)
+    hi = iyb.max(axis=2) + n                              # inclusive last row
+    any_rows = (hi >= 0) & (lo < H2)
+    b_lo = jnp.where(any_rows, jnp.clip(lo, 0, H2 - 1) // h2_blk, 0)
+    b_hi = jnp.where(any_rows, jnp.clip(hi, 0, H2 - 1) // h2_blk, 0)
+    ks = jnp.arange(K, dtype=jnp.int32)[None, None, :]
+    return (b_lo[..., None]
+            + jnp.minimum(ks, (b_hi - b_lo)[..., None])).astype(jnp.int32)
+
+
 def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
                   radius: int, level: int, *, q_blk: int,
                   p_blk_target: int, interpret: bool,
                   corr_precision=jax.lax.Precision.HIGHEST,
-                  lookup_style: str = "matmul") -> jax.Array:
+                  lookup_style: str = "matmul",
+                  p_select: str = "all") -> jax.Array:
     """f1 [B,Q,C], f2_level [B,H2,W2,C], coords [B,Q,2] -> [B,Q,(2r+1)^2]."""
     B, Q, C = f1.shape
     _, H2, W2, _ = f2_level.shape
@@ -153,7 +208,10 @@ def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
 
     if Qp != Q:
         f1 = jnp.pad(f1, ((0, 0), (0, Qp - Q), (0, 0)))
-        coords = jnp.pad(coords, ((0, 0), (0, Qp - Q), (0, 0)))
+        # edge-pad coords (not zeros): padded queries' windows then stay
+        # inside the real queries' row range, so the window schedule of the
+        # tail block is not dragged down to row-block 0
+        coords = jnp.pad(coords, ((0, 0), (0, Qp - Q), (0, 0)), mode="edge")
     f2 = f2_level
     if H2p != H2 or W2p != W2:
         # zero rows/cols correlate to zero -> identical to zeros padding at
@@ -162,23 +220,53 @@ def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
     f2 = f2.reshape(B, H2p * W2p, C)
 
     grid = (B, Qp // T, H2p // h2_blk)
-    kernel = functools.partial(
-        _level_kernel, level_scale=1.0 / (2.0 ** level),
-        corr_scale=1.0 / (C ** 0.5), radius=radius, h2_blk=h2_blk, w2=W2p,
-        corr_precision=corr_precision, lookup_style=lookup_style)
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, T, C), lambda b, j, k: (b, j, 0)),
-            pl.BlockSpec((1, T, 2), lambda b, j, k: (b, j, 0)),
-            pl.BlockSpec((1, h2_blk * W2p, C), lambda b, j, k: (b, k, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, T, n, n), lambda b, j, k: (b, j, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, Qp, n, n), jnp.float32),
-        interpret=interpret,
-    )(f1.astype(jnp.float32), coords.astype(jnp.float32),
-      f2.astype(jnp.float32))
+    f1 = f1.astype(jnp.float32)
+    coords = coords.astype(jnp.float32)
+    f2 = f2.astype(jnp.float32)
+
+    if p_select == "window":
+        K = grid[2]
+        S = _window_schedule(coords, 1.0 / (2.0 ** level), radius, T,
+                             h2_blk, H2, K)
+        kernel = functools.partial(
+            _window_kernel, level_scale=1.0 / (2.0 ** level),
+            corr_scale=1.0 / (C ** 0.5), radius=radius, h2_blk=h2_blk,
+            w2=W2p, corr_precision=corr_precision, lookup_style=lookup_style)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, T, C), lambda b, j, k, S: (b, j, 0)),
+                pl.BlockSpec((1, T, 2), lambda b, j, k, S: (b, j, 0)),
+                pl.BlockSpec((1, h2_blk * W2p, C),
+                             lambda b, j, k, S: (b, S[b, j, k], 0)),
+            ],
+            out_specs=pl.BlockSpec((1, T, n, n),
+                                   lambda b, j, k, S: (b, j, 0, 0)),
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, Qp, n, n), jnp.float32),
+            interpret=interpret,
+        )(S, f1, coords, f2)
+    else:
+        kernel = functools.partial(
+            _level_kernel, level_scale=1.0 / (2.0 ** level),
+            corr_scale=1.0 / (C ** 0.5), radius=radius, h2_blk=h2_blk,
+            w2=W2p, corr_precision=corr_precision, lookup_style=lookup_style)
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, T, C), lambda b, j, k: (b, j, 0)),
+                pl.BlockSpec((1, T, 2), lambda b, j, k: (b, j, 0)),
+                pl.BlockSpec((1, h2_blk * W2p, C), lambda b, j, k: (b, k, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, T, n, n), lambda b, j, k: (b, j, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((B, Qp, n, n), jnp.float32),
+            interpret=interpret,
+        )(f1, coords, f2)
     out = out.reshape(B, Qp, n * n)
     return out[:, :Q] if Qp != Q else out
 
@@ -188,7 +276,8 @@ def _fused_lookup_impl(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
                        q_blk: int = 128, p_blk_target: int = 4096,
                        interpret: Optional[bool] = None,
                        corr_precision=jax.lax.Precision.HIGHEST,
-                       lookup_style: str = "matmul") -> jax.Array:
+                       lookup_style: str = "matmul",
+                       p_select: str = "all") -> jax.Array:
     B, H, W, C = fmap1.shape
     Q = H * W
     if lookup_style not in ("matmul", "vpu"):
@@ -196,6 +285,9 @@ def _fused_lookup_impl(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
         # must not quietly run the other formulation
         raise ValueError(f"lookup_style must be 'matmul' or 'vpu', "
                          f"got {lookup_style!r}")
+    if p_select not in ("all", "window"):
+        raise ValueError(f"p_select must be 'all' or 'window', "
+                         f"got {p_select!r}")
     interp = _use_interpret() if interpret is None else interpret
     f1 = fmap1.reshape(B, Q, C)
     cf = coords.reshape(B, Q, 2)
@@ -203,18 +295,19 @@ def _fused_lookup_impl(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
         _lookup_level(f1, f2l, cf, radius, i, q_blk=q_blk,
                       p_blk_target=p_blk_target, interpret=interp,
                       corr_precision=corr_precision,
-                      lookup_style=lookup_style)
+                      lookup_style=lookup_style, p_select=p_select)
         for i, f2l in enumerate(f2_levels)
     ]
     return jnp.concatenate(outs, axis=-1).reshape(B, H, W, -1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def fused_lookup(fmap1: jax.Array, f2_levels: Tuple[jax.Array, ...],
                  coords: jax.Array, radius: int,
                  corr_precision=jax.lax.Precision.HIGHEST,
                  q_blk: int = 128, p_blk_target: int = 4096,
-                 lookup_style: str = "matmul") -> jax.Array:
+                 lookup_style: str = "matmul",
+                 p_select: str = "all") -> jax.Array:
     """Pallas-fused correlation lookup.
 
     fmap1 [B,H,W,C], f2_levels tuple of [B,H/2^i,W/2^i,C], coords [B,H,W,2]
@@ -223,20 +316,21 @@ def fused_lookup(fmap1: jax.Array, f2_levels: Tuple[jax.Array, ...],
     return _fused_lookup_impl(fmap1, f2_levels, coords, radius,
                               q_blk=q_blk, p_blk_target=p_blk_target,
                               corr_precision=corr_precision,
-                              lookup_style=lookup_style)
+                              lookup_style=lookup_style, p_select=p_select)
 
 
 def _fused_lookup_fwd(fmap1, f2_levels, coords, radius, corr_precision,
-                      q_blk, p_blk_target, lookup_style):
+                      q_blk, p_blk_target, lookup_style, p_select):
     return _fused_lookup_impl(fmap1, f2_levels, coords, radius,
                               q_blk=q_blk, p_blk_target=p_blk_target,
                               corr_precision=corr_precision,
-                              lookup_style=lookup_style), (
+                              lookup_style=lookup_style,
+                              p_select=p_select), (
         fmap1, f2_levels, coords)
 
 
 def _fused_lookup_bwd(radius, corr_precision, q_blk, p_blk_target,
-                      lookup_style, residuals, g):
+                      lookup_style, p_select, residuals, g):
     # gradients via the matmul-only XLA twin (no gathers in the backward);
     # the configured corr precision applies to the backward matmuls too —
     # 'highest' must not silently degrade to bf16 MXU inputs in training
@@ -254,7 +348,7 @@ fused_lookup.defvjp(_fused_lookup_fwd, _fused_lookup_bwd)
 def make_fused_lookup(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
                       radius: int, corr_precision="highest",
                       q_blk: int = 128, p_blk_target: int = 4096,
-                      lookup_style: str = "matmul"):
+                      lookup_style: str = "matmul", p_select: str = "all"):
     """Build the per-iteration lookup closure used by models/raft.py.
 
     Pools the fmap2 pyramid once; each GRU iteration then runs the fused
@@ -272,6 +366,6 @@ def make_fused_lookup(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
 
     def lookup(coords: jax.Array) -> jax.Array:
         return fused_lookup(fmap1, f2_levels, coords, radius, prec,
-                            q_blk, p_blk_target, lookup_style)
+                            q_blk, p_blk_target, lookup_style, p_select)
 
     return lookup

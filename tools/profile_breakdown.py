@@ -132,7 +132,8 @@ def main():
             fn = make_fused_lookup(f1, f2, cfg.corr_levels, cfg.corr_radius,
                                    corr_precision=prec, q_blk=cfg.pallas_q_blk,
                                    p_blk_target=cfg.pallas_p_blk,
-                                   lookup_style=cfg.pallas_lookup_style)
+                                   lookup_style=cfg.pallas_lookup_style,
+                                   p_select=cfg.pallas_p_select)
             return fn(coords=coords)
 
         compiled = lookup.lower(f1, f2, coords).compile()
