@@ -113,7 +113,11 @@ def _cfg_for(name: str):
     impl = ("pallas" if name.startswith("pallas")
             else "dense" if name.startswith("dense")
             else "blockwise" if name.startswith("blockwise") else name)
-    window = name.endswith("-win")
+    # pallas suffixes compose: -win (window schedule), -pack (row packing),
+    # -winpack (both)
+    suffix = name.split("bf16corr")[-1] if "bf16corr" in name else ""
+    window = "win" in suffix
+    pack = "pack" in suffix
     return RAFTConfig.full(
         corr_impl=impl,
         corr_precision=("default" if name.startswith("pallas-bf16corr")
@@ -123,6 +127,7 @@ def _cfg_for(name: str):
         # window schedule wants fine row-blocks so there is something to skip
         pallas_p_select="window" if window else "all",
         pallas_p_blk=1024 if window else RAFTConfig.full().pallas_p_blk,
+        pallas_pack=pack,
         compute_dtype="bfloat16")
 
 
@@ -251,6 +256,7 @@ def _run(args, t_start: float, result: dict) -> None:
     # still measures the likely winner; best one is the headline number
     candidates = ([args.impl] if args.impl
                   else ["pallas-bf16corr", "pallas-bf16corr-win",
+                        "pallas-bf16corr-winpack", "pallas-bf16corr-pack",
                         "pallas-bf16corr-vpu", "pallas", "dense-onehot",
                         "dense", "blockwise-onehot", "blockwise"])
     if jax.default_backend() != "tpu" and not args.impl:

@@ -66,6 +66,12 @@ class RAFTConfig:
     # of the map (use a smaller pallas_p_blk, e.g. 1024, so blocks are fine
     # enough to skip).
     pallas_p_select: str = "all"
+    # Row-packed f2 layout for narrow pyramid levels: lays 128//W2
+    # consecutive rows side by side in the 128-lane width so the corr tile
+    # covers pack x more of the real map (removes lane-padding waste at
+    # coarse levels, and at level 0 for training-crop widths like 496/8=62).
+    # Identical values (parity-tested); measured knob, default off.
+    pallas_pack: bool = False
     # Compute dtype for conv/matmul-heavy paths ('float32' or 'bfloat16');
     # the correlation itself always accumulates in float32.
     compute_dtype: str = "float32"

@@ -169,7 +169,8 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                                    q_blk=config.pallas_q_blk,
                                    p_blk_target=config.pallas_p_blk,
                                    lookup_style=config.pallas_lookup_style,
-                                   p_select=config.pallas_p_select)
+                                   p_select=config.pallas_p_select,
+                                   pack_rows=config.pallas_pack)
     else:
         raise ValueError(config.corr_impl)
 

@@ -122,6 +122,75 @@ def _window_body(sel, f1_ref, coords_ref, f2_ref, *, level_scale: float,
     return win
 
 
+def _packed_body(sel, f1_ref, coords_ref, f2_ref, *, level_scale: float,
+                 corr_scale: float, radius: int, h2_blk: int, w2: int,
+                 w2_real: int, pack: int, corr_precision):
+    """Program body for row-packed f2 layouts.
+
+    Narrow pyramid levels (W2 < 128 lanes) waste most of the MXU tile on
+    lane padding; here ``pack`` consecutive real rows are laid side by side
+    in one packed row of width pack*W2 (w2 = padded lane width), so the corr
+    matmul covers ``pack``x more of the real map per tile.  The bilinear
+    window lookup then needs, per window row i, real rows ty_i (weight 1-fy)
+    and ty_i+1 (weight fy), each living at packed position
+    (ty // pack, (ty % pack) * W2 + x).  Each term is a one-hot y-matmul
+    over packed rows followed by a parity-aware one-hot x reduction; x
+    indices are masked to their own sub-row so windows never wrap into a
+    neighboring packed column ([0 <= tx < W2] guard).
+    """
+    n = 2 * radius + 1
+    f1 = f1_ref[0]                                   # [T, C]
+    f2 = f2_ref[0]                                   # [h2_blk*w2, C] packed
+    T = f1.shape[0]
+    W2 = w2_real                                     # real row width (padded
+    # cols beyond pack*W2 hold zeros and are never matched)
+    corr = jax.lax.dot_general(
+        f1, f2, (((1,), (1,)), ((), ())),
+        precision=corr_precision,
+        preferred_element_type=jnp.float32) * corr_scale
+    corr3 = corr.reshape(T, h2_blk, w2)
+
+    c = coords_ref[0] * level_scale                  # [T, 2] (x, y)
+    cx, cy = c[:, 0], c[:, 1]
+    cx0 = jnp.floor(cx)
+    cy0 = jnp.floor(cy)
+    fx = cx - cx0                                    # [T]
+    fy = cy - cy0                                    # [T]
+    ix0 = cx0.astype(jnp.int32) - radius
+    iy0 = cy0.astype(jnp.int32) - radius
+
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, (T, n), 1)
+    ty_base = iy0[:, None] + iota_n                  # [T, n]  y-window rows
+    tx = ix0[:, None] + iota_n                       # [T, n]  x-window taps
+    h_ids = (jax.lax.broadcasted_iota(jnp.int32, (T, n, h2_blk), 2)
+             + sel * h2_blk)                         # packed rows of this blk
+    u_ids = jax.lax.broadcasted_iota(jnp.int32, (T, n, n, w2), 3)
+    fx4 = fx[:, None, None, None]
+    x_ok0 = ((tx >= 0) & (tx < W2))[:, :, None, None]       # [T, n(j), 1, 1]
+    x_ok1 = ((tx + 1 >= 0) & (tx + 1 < W2))[:, :, None, None]
+
+    win = None
+    for wy, row_delta in ((1.0 - fy, 0), (fy, 1)):   # the two y taps
+        ty = ty_base + row_delta                     # [T, n]
+        prow = jnp.floor_divide(ty, pack)            # packed row of the tap
+        parity = ty - prow * pack                    # sub-row within the pack
+        a_y = jnp.where(h_ids == prow[:, :, None], wy[:, None, None], 0.0)
+        win_y = jax.lax.dot_general(                 # [T, n(y), w2]
+            a_y, corr3, (((2,), (1,)), ((0,), (0,))),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+        # parity-aware x one-hot: tap (i, j) lives at u = parity_i*W2 + tx_j,
+        # masked to its own sub-row so windows never wrap into a neighboring
+        # packed column; per-(i,j) u targets differ, so the x contraction is
+        # a broadcast-multiply-reduce over u (VPU work, j-major output)
+        u0 = (parity[:, None, :] * W2 + tx[:, :, None])[..., None]
+        a_x = (jnp.where((u_ids == u0) & x_ok0, 1.0 - fx4, 0.0)
+               + jnp.where((u_ids == u0 + 1) & x_ok1, fx4, 0.0))
+        term = jnp.sum(a_x * win_y[:, None, :, :], axis=3)  # [T, n(x), n(y)]
+        win = term if win is None else win + term
+    return win
+
+
 def _accumulate(out_ref, win, k):
     @pl.when(k == 0)
     def _():
@@ -132,15 +201,15 @@ def _accumulate(out_ref, win, k):
         out_ref[0] = out_ref[0] + win
 
 
-def _level_kernel(f1_ref, coords_ref, f2_ref, out_ref, **body_kw):
+def _level_kernel(f1_ref, coords_ref, f2_ref, out_ref, *, body):
     """One (batch, query-block, p-block) program: the k-th grid step visits
     f2 row-block k (full pass over the map)."""
     k = pl.program_id(2)
-    win = _window_body(k, f1_ref, coords_ref, f2_ref, **body_kw)
+    win = body(k, f1_ref, coords_ref, f2_ref)
     _accumulate(out_ref, win, k)
 
 
-def _window_kernel(S_ref, f1_ref, coords_ref, f2_ref, out_ref, **body_kw):
+def _window_kernel(S_ref, f1_ref, coords_ref, f2_ref, out_ref, *, body):
     """Window-scheduled program: identical math to ``_level_kernel`` but the
     k-th grid step visits f2 row-block ``S[b, j, k]`` instead of row-block
     ``k``.  The schedule repeats its last needed block to fill the static
@@ -155,17 +224,19 @@ def _window_kernel(S_ref, f1_ref, coords_ref, f2_ref, out_ref, **body_kw):
 
     @pl.when((k == 0) | (sel != prev))
     def _():
-        win = _window_body(sel, f1_ref, coords_ref, f2_ref, **body_kw)
+        win = body(sel, f1_ref, coords_ref, f2_ref)
         _accumulate(out_ref, win, k)
 
 
 def _window_schedule(coords: jax.Array, level_scale: float, radius: int,
-                     T: int, h2_blk: int, H2: int, K: int) -> jax.Array:
+                     T: int, h2_blk: int, H2: int, K: int,
+                     pack: int = 1) -> jax.Array:
     """Per (batch, query-block) contiguous range of f2 row-blocks its bilinear
     windows can touch, as a [B, Qb, K] block-index schedule.  Entries past
     the needed range repeat the last needed block (skip marker).  Fully
     out-of-map windows contribute zeros via the one-hot construction, so
-    pointing them at block 0 is safe."""
+    pointing them at block 0 is safe.  ``h2_blk`` counts *packed* rows when
+    ``pack`` > 1 (each packed row holds ``pack`` real rows)."""
     B, Qp, _ = coords.shape
     n = 2 * radius + 1
     cy = coords[..., 1] * level_scale                     # [B, Qp]
@@ -174,8 +245,9 @@ def _window_schedule(coords: jax.Array, level_scale: float, radius: int,
     lo = iyb.min(axis=2)
     hi = iyb.max(axis=2) + n                              # inclusive last row
     any_rows = (hi >= 0) & (lo < H2)
-    b_lo = jnp.where(any_rows, jnp.clip(lo, 0, H2 - 1) // h2_blk, 0)
-    b_hi = jnp.where(any_rows, jnp.clip(hi, 0, H2 - 1) // h2_blk, 0)
+    rows_per_blk = h2_blk * pack
+    b_lo = jnp.where(any_rows, jnp.clip(lo, 0, H2 - 1) // rows_per_blk, 0)
+    b_hi = jnp.where(any_rows, jnp.clip(hi, 0, H2 - 1) // rows_per_blk, 0)
     ks = jnp.arange(K, dtype=jnp.int32)[None, None, :]
     return (b_lo[..., None]
             + jnp.minimum(ks, (b_hi - b_lo)[..., None])).astype(jnp.int32)
@@ -186,7 +258,8 @@ def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
                   p_blk_target: int, interpret: bool,
                   corr_precision=jax.lax.Precision.HIGHEST,
                   lookup_style: str = "matmul",
-                  p_select: str = "all") -> jax.Array:
+                  p_select: str = "all",
+                  pack_rows: bool = False) -> jax.Array:
     """f1 [B,Q,C], f2_level [B,H2,W2,C], coords [B,Q,2] -> [B,Q,(2r+1)^2]."""
     B, Q, C = f1.shape
     _, H2, W2, _ = f2_level.shape
@@ -198,14 +271,6 @@ def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
 
     T = q_blk if Q >= q_blk else _round_up(Q, 8)
     Qp = _round_up(Q, T)
-    # pad W2 to lane width so the in-kernel [T, Pblk] -> [T, h2_blk, W2p]
-    # reshape is a supported Mosaic shape cast; padded zero columns correlate
-    # to zero, so any one-hot match on them contributes 0 (= zeros padding) —
-    # and the vector unit would have padded the lanes anyway.
-    W2p = _round_up(W2, 128)
-    h2_blk = max(1, min(H2, p_blk_target // W2p))
-    H2p = _round_up(H2, h2_blk)
-
     if Qp != Q:
         f1 = jnp.pad(f1, ((0, 0), (0, Qp - Q), (0, 0)))
         # edge-pad coords (not zeros): padded queries' windows then stay
@@ -213,25 +278,53 @@ def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
         # tail block is not dragged down to row-block 0
         coords = jnp.pad(coords, ((0, 0), (0, Qp - Q), (0, 0)), mode="edge")
     f2 = f2_level
-    if H2p != H2 or W2p != W2:
-        # zero rows/cols correlate to zero -> identical to zeros padding at
-        # the image boundary.
-        f2 = jnp.pad(f2, ((0, 0), (0, H2p - H2), (0, W2p - W2), (0, 0)))
-    f2 = f2.reshape(B, H2p * W2p, C)
 
-    grid = (B, Qp // T, H2p // h2_blk)
+    # Row packing: when the real row width W2 uses at most half the 128
+    # lanes, lay `pack` consecutive rows side by side in one packed row so
+    # the corr tile covers pack x more of the map (no lane-padding waste).
+    pack = max(1, 128 // W2) if pack_rows else 1
+    if pack > 1:
+        H2pk = -(-H2 // pack)                # packed rows
+        W2p = _round_up(pack * W2, 128)      # = 128
+        h2_blk = max(1, min(H2pk, p_blk_target // W2p))
+        H2pkp = _round_up(H2pk, h2_blk)
+        f2 = jnp.pad(f2, ((0, 0), (0, H2pkp * pack - H2), (0, 0), (0, 0)))
+        f2 = f2.reshape(B, H2pkp, pack * W2, C)
+        if W2p != pack * W2:
+            f2 = jnp.pad(f2, ((0, 0), (0, 0), (0, W2p - pack * W2), (0, 0)))
+        n_pblocks = H2pkp // h2_blk
+        body = functools.partial(
+            _packed_body, level_scale=1.0 / (2.0 ** level),
+            corr_scale=1.0 / (C ** 0.5), radius=radius, h2_blk=h2_blk,
+            w2=W2p, w2_real=W2, pack=pack, corr_precision=corr_precision)
+    else:
+        # pad W2 to lane width so the in-kernel [T, Pblk] -> [T, h2_blk, W2p]
+        # reshape is a supported Mosaic shape cast; padded zero columns
+        # correlate to zero, so any one-hot match on them contributes 0
+        # (= zeros padding) — and the vector unit would have padded the
+        # lanes anyway.
+        W2p = _round_up(W2, 128)
+        h2_blk = max(1, min(H2, p_blk_target // W2p))
+        H2p = _round_up(H2, h2_blk)
+        if H2p != H2 or W2p != W2:
+            # zero rows/cols correlate to zero -> identical to zeros padding
+            # at the image boundary.
+            f2 = jnp.pad(f2, ((0, 0), (0, H2p - H2), (0, W2p - W2), (0, 0)))
+        n_pblocks = H2p // h2_blk
+        body = functools.partial(
+            _window_body, level_scale=1.0 / (2.0 ** level),
+            corr_scale=1.0 / (C ** 0.5), radius=radius, h2_blk=h2_blk,
+            w2=W2p, corr_precision=corr_precision, lookup_style=lookup_style)
+    f2 = f2.reshape(B, -1, C)
+
+    grid = (B, Qp // T, n_pblocks)
     f1 = f1.astype(jnp.float32)
     coords = coords.astype(jnp.float32)
     f2 = f2.astype(jnp.float32)
 
     if p_select == "window":
-        K = grid[2]
         S = _window_schedule(coords, 1.0 / (2.0 ** level), radius, T,
-                             h2_blk, H2, K)
-        kernel = functools.partial(
-            _window_kernel, level_scale=1.0 / (2.0 ** level),
-            corr_scale=1.0 / (C ** 0.5), radius=radius, h2_blk=h2_blk,
-            w2=W2p, corr_precision=corr_precision, lookup_style=lookup_style)
+                             h2_blk, H2, grid[2], pack=pack)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
@@ -245,18 +338,14 @@ def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
                                    lambda b, j, k, S: (b, j, 0, 0)),
         )
         out = pl.pallas_call(
-            kernel,
+            functools.partial(_window_kernel, body=body),
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((B, Qp, n, n), jnp.float32),
             interpret=interpret,
         )(S, f1, coords, f2)
     else:
-        kernel = functools.partial(
-            _level_kernel, level_scale=1.0 / (2.0 ** level),
-            corr_scale=1.0 / (C ** 0.5), radius=radius, h2_blk=h2_blk,
-            w2=W2p, corr_precision=corr_precision, lookup_style=lookup_style)
         out = pl.pallas_call(
-            kernel,
+            functools.partial(_level_kernel, body=body),
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, T, C), lambda b, j, k: (b, j, 0)),
@@ -277,7 +366,8 @@ def _fused_lookup_impl(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
                        interpret: Optional[bool] = None,
                        corr_precision=jax.lax.Precision.HIGHEST,
                        lookup_style: str = "matmul",
-                       p_select: str = "all") -> jax.Array:
+                       p_select: str = "all",
+                       pack_rows: bool = False) -> jax.Array:
     B, H, W, C = fmap1.shape
     Q = H * W
     if lookup_style not in ("matmul", "vpu"):
@@ -295,19 +385,21 @@ def _fused_lookup_impl(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
         _lookup_level(f1, f2l, cf, radius, i, q_blk=q_blk,
                       p_blk_target=p_blk_target, interpret=interp,
                       corr_precision=corr_precision,
-                      lookup_style=lookup_style, p_select=p_select)
+                      lookup_style=lookup_style, p_select=p_select,
+                      pack_rows=pack_rows)
         for i, f2l in enumerate(f2_levels)
     ]
     return jnp.concatenate(outs, axis=-1).reshape(B, H, W, -1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def fused_lookup(fmap1: jax.Array, f2_levels: Tuple[jax.Array, ...],
                  coords: jax.Array, radius: int,
                  corr_precision=jax.lax.Precision.HIGHEST,
                  q_blk: int = 128, p_blk_target: int = 4096,
                  lookup_style: str = "matmul",
-                 p_select: str = "all") -> jax.Array:
+                 p_select: str = "all",
+                 pack_rows: bool = False) -> jax.Array:
     """Pallas-fused correlation lookup.
 
     fmap1 [B,H,W,C], f2_levels tuple of [B,H/2^i,W/2^i,C], coords [B,H,W,2]
@@ -316,21 +408,22 @@ def fused_lookup(fmap1: jax.Array, f2_levels: Tuple[jax.Array, ...],
     return _fused_lookup_impl(fmap1, f2_levels, coords, radius,
                               q_blk=q_blk, p_blk_target=p_blk_target,
                               corr_precision=corr_precision,
-                              lookup_style=lookup_style, p_select=p_select)
+                              lookup_style=lookup_style, p_select=p_select,
+                              pack_rows=pack_rows)
 
 
 def _fused_lookup_fwd(fmap1, f2_levels, coords, radius, corr_precision,
-                      q_blk, p_blk_target, lookup_style, p_select):
+                      q_blk, p_blk_target, lookup_style, p_select, pack_rows):
     return _fused_lookup_impl(fmap1, f2_levels, coords, radius,
                               q_blk=q_blk, p_blk_target=p_blk_target,
                               corr_precision=corr_precision,
                               lookup_style=lookup_style,
-                              p_select=p_select), (
+                              p_select=p_select, pack_rows=pack_rows), (
         fmap1, f2_levels, coords)
 
 
 def _fused_lookup_bwd(radius, corr_precision, q_blk, p_blk_target,
-                      lookup_style, p_select, residuals, g):
+                      lookup_style, p_select, pack_rows, residuals, g):
     # gradients via the matmul-only XLA twin (no gathers in the backward);
     # the configured corr precision applies to the backward matmuls too —
     # 'highest' must not silently degrade to bf16 MXU inputs in training
@@ -348,7 +441,8 @@ fused_lookup.defvjp(_fused_lookup_fwd, _fused_lookup_bwd)
 def make_fused_lookup(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
                       radius: int, corr_precision="highest",
                       q_blk: int = 128, p_blk_target: int = 4096,
-                      lookup_style: str = "matmul", p_select: str = "all"):
+                      lookup_style: str = "matmul", p_select: str = "all",
+                      pack_rows: bool = False):
     """Build the per-iteration lookup closure used by models/raft.py.
 
     Pools the fmap2 pyramid once; each GRU iteration then runs the fused
@@ -366,6 +460,7 @@ def make_fused_lookup(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
 
     def lookup(coords: jax.Array) -> jax.Array:
         return fused_lookup(fmap1, f2_levels, coords, radius, prec,
-                            q_blk, p_blk_target, lookup_style, p_select)
+                            q_blk, p_blk_target, lookup_style, p_select,
+                            pack_rows)
 
     return lookup

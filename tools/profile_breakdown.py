@@ -98,6 +98,26 @@ def main():
     print(f"fnet x2 frames         : {dt_f * 1e3:8.3f} ms")
     f1v, f2v = comp(params, x1, x2)
 
+    # per-stage split of one fnet pass (2B-batched, as in the model); the
+    # truncation lives in apply_encoder itself so this measures exactly the
+    # structure the model runs
+    def through(depth):
+        def fn(p, a, b):
+            y, _ = apply_encoder(p["fnet"], jnp.concatenate([a, b], 0),
+                                 "instance", small=cfg.small, train=False,
+                                 stages=depth)
+            return y
+        return fn
+
+    prev = 0.0
+    for depth, label in ((0, "conv1+norm"), (1, "+layer1"), (2, "+layer2"),
+                         (3, "+layer3")):
+        comp = jax.jit(through(depth)).lower(params, x1, x2).compile()
+        dt = measure(comp, (params, x1, x2))
+        print(f"  fnet {label:<10}       : {dt * 1e3:8.3f} ms "
+              f"(stage {max(dt - prev, 0.0) * 1e3:+.3f} ms)")
+        prev = dt
+
     comp = jax.jit(cnet_fn).lower(params, x1).compile()
     print(f"cnet                   : {measure(comp, (params, x1)) * 1e3:8.3f} ms")
 
@@ -133,7 +153,8 @@ def main():
                                    corr_precision=prec, q_blk=cfg.pallas_q_blk,
                                    p_blk_target=cfg.pallas_p_blk,
                                    lookup_style=cfg.pallas_lookup_style,
-                                   p_select=cfg.pallas_p_select)
+                                   p_select=cfg.pallas_p_select,
+                                   pack_rows=cfg.pallas_pack)
             return fn(coords=coords)
 
         compiled = lookup.lower(f1, f2, coords).compile()

@@ -49,6 +49,11 @@ def main() -> int:
                         "MXU inputs, the bench winner's setting)")
     p.add_argument("--style", default="matmul", choices=["matmul", "vpu"],
                    help="window-lookup formulation inside the kernel")
+    p.add_argument("--p-select", default="all", choices=["all", "window"],
+                   help="row-block schedule: full pass or the prefetched "
+                        "window schedule (skips non-overlapping blocks)")
+    p.add_argument("--pack", action="store_true",
+                   help="row-packed f2 lanes for narrow levels")
     args = p.parse_args()
 
     import jax
@@ -66,7 +71,8 @@ def main() -> int:
     prec = (jax.lax.Precision.HIGHEST if args.precision == "highest"
             else jax.lax.Precision.DEFAULT)
     print(f"# device: {dev.device_kind}  corr precision: {args.precision}  "
-          f"lookup style: {args.style}")
+          f"lookup style: {args.style}  p_select: {args.p_select}  "
+          f"pack: {args.pack}")
 
     # (label, B, full-res H, W); fmaps are at os=8, C=256 (full model)
     shapes = [("eval 1x432x1024", 1, 432, 1024),
@@ -92,7 +98,8 @@ def main() -> int:
             fn = jax.jit(functools.partial(
                 _fused_lookup_impl, radius=args.radius, q_blk=q_blk,
                 p_blk_target=p_blk, interpret=False, corr_precision=prec,
-                lookup_style=args.style))
+                lookup_style=args.style, p_select=args.p_select,
+                pack_rows=args.pack))
             try:
                 dt = _measure(fn, (fmap1, f2_levels, coords),
                               reps=8 if args.quick else 20)
