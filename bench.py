@@ -114,10 +114,11 @@ def _cfg_for(name: str):
             else "dense" if name.startswith("dense")
             else "blockwise" if name.startswith("blockwise") else name)
     # pallas suffixes compose: -win (window schedule), -pack (row packing),
-    # -winpack (both)
-    suffix = name.split("bf16corr")[-1] if "bf16corr" in name else ""
-    window = "win" in suffix
-    pack = "pack" in suffix
+    # -winpack (both); they apply to any pallas candidate name, not just
+    # the bf16corr family
+    tokens = name.split("-")
+    window = any(t in ("win", "winpack") for t in tokens)
+    pack = any(t in ("pack", "winpack") for t in tokens)
     return RAFTConfig.full(
         corr_impl=impl,
         corr_precision=("default" if name.startswith("pallas-bf16corr")

@@ -130,7 +130,12 @@ def _packed_body(sel, f1_ref, coords_ref, f2_ref, *, level_scale: float,
     Narrow pyramid levels (W2 < 128 lanes) waste most of the MXU tile on
     lane padding; here ``pack`` consecutive real rows are laid side by side
     in one packed row of width pack*W2 (w2 = padded lane width), so the corr
-    matmul covers ``pack``x more of the real map per tile.  The bilinear
+    matmul covers ``pack``x more of the real map per tile.
+
+    This body has a single, fixed lookup formulation (one-hot y-matmul +
+    parity-aware VPU x-reduction) — ``lookup_style`` does not apply to
+    packed levels; levels too wide to pack still honor it via
+    ``_window_body``.  The bilinear
     window lookup then needs, per window row i, real rows ty_i (weight 1-fy)
     and ty_i+1 (weight fy), each living at packed position
     (ty // pack, (ty % pack) * W2 + x).  Each term is a one-hot y-matmul

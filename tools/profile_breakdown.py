@@ -56,6 +56,15 @@ def main():
     im1 = jax.random.uniform(k1, (B, H, W, 3), jnp.float32)
     im2 = jax.random.uniform(k2, (B, H, W, 3), jnp.float32)
 
+    # Null-call floor: a trivial jitted fn through the same timing loop.
+    # Under the tunneled backend each executed call pays an RPC round trip;
+    # this floor is NOT device time and must be subtracted mentally from
+    # every absolute number below (the per-iteration slope is immune).
+    tiny = jnp.ones((8, 128), jnp.float32)
+    comp0 = jax.jit(lambda x: x + 1.0).lower(tiny).compile()
+    print(f"null-call overhead     : {measure(comp0, (tiny,)) * 1e3:8.3f} ms",
+          flush=True)
+
     times = {}
     for iters in (1, 2, 8, 12):
         fn = jax.jit(make_inference_fn(cfg, iters=iters))

@@ -53,7 +53,9 @@ def main() -> int:
                    help="row-block schedule: full pass or the prefetched "
                         "window schedule (skips non-overlapping blocks)")
     p.add_argument("--pack", action="store_true",
-                   help="row-packed f2 lanes for narrow levels")
+                   help="row-packed f2 lanes for narrow levels (packed "
+                        "levels use their own fixed contraction; --style "
+                        "only affects levels too wide to pack)")
     args = p.parse_args()
 
     import jax
