@@ -13,7 +13,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.conv import apply_conv, init_conv
+from ..ops.conv import apply_conv, apply_conv_fused, init_conv
 
 
 # ---------------------------------------------------------- motion encoders
@@ -74,8 +74,11 @@ def init_sep_conv_gru(key, hidden: int, input_dim: int) -> dict:
 def apply_sep_conv_gru(p: dict, h: jax.Array, x: jax.Array) -> jax.Array:
     for suffix in ("1", "2"):        # horizontal (1x5) then vertical (5x1)
         hx = jnp.concatenate([h, x], -1)
-        z = jax.nn.sigmoid(apply_conv(p["convz" + suffix], hx))
-        r = jax.nn.sigmoid(apply_conv(p["convr" + suffix], hx))
+        # z and r read the same input -> one fused conv (exact; see
+        # apply_conv_fused)
+        zc, rc = apply_conv_fused((p["convz" + suffix], p["convr" + suffix]), hx)
+        z = jax.nn.sigmoid(zc)
+        r = jax.nn.sigmoid(rc)
         q = jnp.tanh(apply_conv(p["convq" + suffix], jnp.concatenate([r * h, x], -1)))
         h = (1.0 - z) * h + z * q
     return h
@@ -93,8 +96,9 @@ def init_conv_gru(key, hidden: int, input_dim: int) -> dict:
 
 def apply_conv_gru(p: dict, h: jax.Array, x: jax.Array) -> jax.Array:
     hx = jnp.concatenate([h, x], -1)
-    z = jax.nn.sigmoid(apply_conv(p["convz"], hx))
-    r = jax.nn.sigmoid(apply_conv(p["convr"], hx))
+    zc, rc = apply_conv_fused((p["convz"], p["convr"]), hx)
+    z = jax.nn.sigmoid(zc)
+    r = jax.nn.sigmoid(rc)
     q = jnp.tanh(apply_conv(p["convq"], jnp.concatenate([r * h, x], -1)))
     return (1.0 - z) * h + z * q
 
@@ -116,9 +120,10 @@ def init_mask_head(key, in_dim: int) -> dict:
     return {"0": init_conv(k1, 3, in_dim, 256), "2": init_conv(k2, 1, 256, 64 * 9)}
 
 
-def apply_mask_head(p: dict, x: jax.Array) -> jax.Array:
-    m = jax.nn.relu(apply_conv(p["0"], x))
-    return 0.25 * apply_conv(p["2"], m)   # .25 scale as in official / reference
+# .25 mask scale as in official RAFT / reference; applied in
+# apply_basic_update_block (the mask head's first conv is fused with the
+# flow head's there).
+MASK_SCALE = 0.25
 
 
 # ------------------------------------------------------------ update blocks
@@ -140,8 +145,11 @@ def apply_basic_update_block(p: dict, net: jax.Array, inp: jax.Array,
     motion = apply_basic_motion_encoder(p["encoder"], flow, corr)
     x = jnp.concatenate([inp, motion], -1)
     net = apply_sep_conv_gru(p["gru"], net, x)
-    delta_flow = apply_flow_head(p["flow_head"], net)
-    mask = apply_mask_head(p["mask"], net)
+    # flow head conv1 and mask head [0] both read `net` with 3x3 kernels ->
+    # one fused conv (exact), then each branch's own tail
+    fh, mh = apply_conv_fused((p["flow_head"]["conv1"], p["mask"]["0"]), net)
+    delta_flow = apply_conv(p["flow_head"]["conv2"], jax.nn.relu(fh))
+    mask = MASK_SCALE * apply_conv(p["mask"]["2"], jax.nn.relu(mh))
     return net, mask, delta_flow
 
 

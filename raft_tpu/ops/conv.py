@@ -69,6 +69,35 @@ def apply_conv(params: dict, x: jax.Array, stride: int = 1, compute_dtype=None) 
     return conv2d(x, params["w"], params.get("b"), stride=stride, compute_dtype=compute_dtype)
 
 
+def apply_conv_fused(params_list: Sequence[dict], x: jax.Array,
+                     stride: int = 1) -> Tuple[jax.Array, ...]:
+    """Run several same-input, same-kernel-size convolutions as ONE conv.
+
+    Convolution is linear in the kernel, so concatenating the output-channel
+    axis is mathematically identical to separate calls — but the fused op
+    reads the input once and issues one MXU matmul instead of N (the update
+    block's z/r gates and flow/mask head first convs all share inputs).
+    Parameters stay separate dicts (checkpoint format untouched); the
+    concatenation happens at apply time and is loop-invariant, so XLA hoists
+    it out of the GRU scan.  Returns the per-conv output slices.
+    """
+    w = jnp.concatenate([p["w"] for p in params_list], axis=3)
+    bs = [p.get("b") for p in params_list]
+    fuse_bias = all(b_ is not None for b_ in bs)
+    out = conv2d(x, w, jnp.concatenate(bs) if fuse_bias else None,
+                 stride=stride)
+    splits, start = [], 0
+    for p in params_list:
+        c = p["w"].shape[3]
+        piece = out[..., start:start + c]
+        if not fuse_bias and p.get("b") is not None:
+            # mixed biased/bias-free convs: add per-slice afterwards
+            piece = piece + p["b"].astype(piece.dtype)
+        splits.append(piece)
+        start += c
+    return tuple(splits)
+
+
 def avg_pool2d(x: jax.Array, window: int = 2, stride: int = 2) -> jax.Array:
     """Average pooling over H, W of [B, H, W, C] (VALID padding), as the
     reference's pyramid pooling uses (reference model_utils.py:218)."""

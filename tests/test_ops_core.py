@@ -132,3 +132,20 @@ def test_convex_upsample_matches_torch_unfold():
 
     got = np.asarray(convex_upsample_flow(jnp.asarray(flow), jnp.asarray(mask)))
     np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_apply_conv_fused_matches_separate():
+    """Fusing same-input same-kernel convs along output channels is exact
+    (convolution is linear in the kernel); used by the GRU z/r gates and
+    the flow/mask head first convs."""
+    from raft_tpu.ops.conv import apply_conv, apply_conv_fused, init_conv
+
+    k = jax.random.split(jax.random.PRNGKey(0), 3)
+    p1 = init_conv(k[0], (1, 5), 24, 16)
+    p2 = init_conv(k[1], (1, 5), 24, 16)
+    p3 = init_conv(k[2], (1, 5), 24, 8, bias=False)   # mixed-bias case
+    x = jax.random.normal(jax.random.PRNGKey(9), (2, 6, 10, 24))
+    outs = apply_conv_fused((p1, p2, p3), x)
+    for got, p in zip(outs, (p1, p2, p3)):
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(apply_conv(p, x)), atol=1e-6)
