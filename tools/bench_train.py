@@ -28,8 +28,10 @@ def main() -> int:
     p.add_argument("--batch", type=int, default=6)
     p.add_argument("--iters", type=int, default=12)
     p.add_argument("--impl", default="pallas")
-    p.add_argument("--precision", default="default",
-                   choices=["default", "highest"])
+    p.add_argument("--precision", default=None,
+                   choices=["default", "highest"],
+                   help="override the candidate's corr precision (default: "
+                        "whatever the candidate name means in bench.py)")
     p.add_argument("--quick", action="store_true",
                    help="tiny shapes for CI smoke (64x96, batch 2, 3 iters)")
     p.add_argument("--cpu", action="store_true")
@@ -50,12 +52,18 @@ def main() -> int:
 
     dev = jax.devices()[0]
     impl = args.impl
-    if jax.default_backend() != "tpu" and impl == "pallas":
+    if jax.default_backend() != "tpu" and impl.startswith("pallas"):
         impl = "blockwise"     # interpret mode would swamp the timing
     H, W = args.size
-    config = RAFTConfig.full(iters=args.iters, corr_impl=impl,
-                             corr_precision=args.precision,
-                             compute_dtype="bfloat16")
+    # candidate names share bench.py's mapping (-win/-pack/-winpack etc.);
+    # explicit --precision and the training iteration count then override
+    import dataclasses
+
+    from bench import _cfg_for
+    config = dataclasses.replace(_cfg_for(impl), iters=args.iters,
+                                 compute_dtype="bfloat16")
+    if args.precision is not None:
+        config = dataclasses.replace(config, corr_precision=args.precision)
     tconfig = TrainConfig(num_steps=1000, batch_size=args.batch,
                           image_size=(H, W))
     tx = make_optimizer(tconfig)
@@ -82,7 +90,7 @@ def main() -> int:
 
     print(json.dumps({
         "metric": f"raft-things train-step throughput @ {args.iters} iters, "
-                  f"{args.batch}x{H}x{W} ({impl}, {args.precision})",
+                  f"{args.batch}x{H}x{W} ({impl}, {config.corr_precision})",
         "device": dev.device_kind,
         "value": round(args.batch / dt, 4),
         "unit": "pairs/sec/chip",
