@@ -1,0 +1,79 @@
+"""Real-TPU smoke for the fused-kernel variants: Mosaic compile + on-device
+parity vs the dense XLA oracle for every (p_select, pack_rows) combination.
+
+Interpret-mode tests prove kernel *semantics*; this proves Mosaic *lowering*
+on actual hardware (scalar-prefetch index maps, packed reshapes, pl.when
+accumulation) — run it first whenever the kernel changes, before spending
+tunnel time on sweeps.
+
+Usage: python tools/hw_smoke.py [--full]   (--full adds the training shape)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--full", action="store_true",
+                   help="also run the batch-6 training shape")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        print("ERROR: hw_smoke needs the TPU backend", file=sys.stderr)
+        return 2
+
+    from raft_tpu.ops.coords import coords_grid
+    from raft_tpu.ops.corr import build_pyramid, fmap2_pyramid, lookup_dense
+    from raft_tpu.ops.corr_pallas import _fused_lookup_impl
+
+    print(f"# device: {jax.devices()[0].device_kind}", flush=True)
+    shapes = [("eval 1x432x1024", 1, 54, 128, 256, 4, 4)]
+    if args.full:
+        shapes.append(("train 6x368x496", 6, 46, 62, 256, 4, 4))
+
+    failures = 0
+    for label, B, h, w, C, levels, radius in shapes:
+        key = jax.random.PRNGKey(0)
+        k1, k2, k3 = jax.random.split(key, 3)
+        f1 = jax.random.normal(k1, (B, h, w, C), jnp.float32)
+        f2 = jax.random.normal(k2, (B, h, w, C), jnp.float32)
+        coords = (coords_grid(B, h, w)
+                  + jax.random.uniform(k3, (B, h, w, 2), minval=-8, maxval=8))
+        want = np.asarray(lookup_dense(build_pyramid(f1, f2, levels), coords,
+                                       radius))
+        f2_levels = tuple(fmap2_pyramid(f2, levels))
+        for p_select, pack in (("all", False), ("window", False),
+                               ("all", True), ("window", True)):
+            name = f"{p_select}{'+pack' if pack else ''}"
+            try:
+                got = np.asarray(_fused_lookup_impl(
+                    f1, f2_levels, coords, radius, q_blk=128,
+                    p_blk_target=1024 if (p_select == "window" or pack)
+                    else 4096,
+                    interpret=False, p_select=p_select, pack_rows=pack))
+                err = np.abs(got - want).max()
+                ok = err < 1e-4
+                print(f"{label}  {name:<12} max|err|={err:.2e}  "
+                      f"{'OK' if ok else 'FAIL'}", flush=True)
+                failures += (not ok)
+            except Exception as e:   # noqa: BLE001 — report every combo
+                print(f"{label}  {name:<12} RAISED {type(e).__name__}: "
+                      f"{str(e)[:200]}", flush=True)
+                failures += 1
+    print(f"# {failures} failures", flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
