@@ -50,8 +50,12 @@ def main() -> int:
         f2 = jax.random.normal(k2, (B, h, w, C), jnp.float32)
         coords = (coords_grid(B, h, w)
                   + jax.random.uniform(k3, (B, h, w, 2), minval=-8, maxval=8))
-        want = np.asarray(lookup_dense(build_pyramid(f1, f2, levels), coords,
-                                       radius))
+        # oracle at HIGHEST precision: default would lower the fp32
+        # contraction to bf16 MXU inputs on TPU and swamp the 1e-4 gate
+        want = np.asarray(lookup_dense(
+            build_pyramid(f1, f2, levels,
+                          precision=jax.lax.Precision.HIGHEST),
+            coords, radius))
         f2_levels = tuple(fmap2_pyramid(f2, levels))
         for p_select, pack in (("all", False), ("window", False),
                                ("all", True), ("window", True)):
