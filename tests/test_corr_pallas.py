@@ -229,3 +229,36 @@ def test_row_packed_model_forward():
     # test_model_forward_pallas_vs_dense
     np.testing.assert_allclose(np.asarray(out_a.flow), np.asarray(out_b.flow),
                                rtol=1e-3, atol=1e-3)
+
+
+def test_window_schedule_invariants():
+    """The prefetched schedule must (a) stay within [0, K-1], (b) be
+    non-decreasing with its active prefix strictly increasing then constant,
+    and (c) cover every row-block any query's bilinear window touches —
+    the properties the kernel's skip logic and the DMA index map rely on."""
+    from raft_tpu.ops.corr_pallas import _window_schedule
+
+    B, Qp, T, radius = 2, 256, 64, 4
+    n = 2 * radius + 1
+    H2, h2_blk = 54, 8
+    K = -(-H2 // h2_blk)     # H2p // h2_blk, the kernel's real grid length
+    key = jax.random.PRNGKey(11)
+    coords = jax.random.uniform(key, (B, Qp, 2), minval=-20.0, maxval=80.0)
+    S = np.asarray(_window_schedule(coords, 1.0, radius, T, h2_blk, H2, K))
+    assert S.shape == (B, Qp // T, K)
+    assert S.min() >= 0 and S.max() <= K - 1, (S.min(), S.max())
+    d = np.diff(S, axis=2)
+    assert (d >= 0).all(), "schedule must be non-decreasing"
+    assert (d <= 1).all(), "schedule visits contiguous blocks"
+
+    cy = np.asarray(coords[..., 1]).reshape(B, Qp // T, T)
+    iy0 = np.floor(cy).astype(int) - radius
+    for b in range(B):
+        for j in range(Qp // T):
+            touched = set()
+            for t in range(T):
+                for row in range(iy0[b, j, t], iy0[b, j, t] + n + 1):
+                    if 0 <= row < H2:
+                        touched.add(row // h2_blk)
+            assert touched <= set(S[b, j].tolist()), (
+                b, j, touched, S[b, j].tolist())
