@@ -23,14 +23,19 @@ from .mesh import DATA_AXIS
 def make_dp_train_step(config: RAFTConfig, tconfig: TrainConfig, tx,
                        mesh: Mesh, axis: str = DATA_AXIS):
     """Returns jitted (state, batch, rng) -> (state, metrics) with the batch
-    sharded over ``axis`` and state replicated."""
+    sharded over ``axis`` and state replicated.
+
+    The input state is DONATED (consumed): rebind ``state = step(state, ...)``
+    and never reuse the old one — reuse raises 'Array has been deleted'."""
     inner = make_train_step(config, tconfig, tx, axis_name=axis)
     batch_spec = Batch(P(axis), P(axis), P(axis), P(axis))
     f = jax.shard_map(inner, mesh=mesh,
                       in_specs=(P(), batch_spec, P()),
                       out_specs=(P(), P()),
                       check_vma=False)
-    return jax.jit(f)
+    # donate the input state: the loop rebinds `state = step(state, ...)`,
+    # so the old buffers are dead — donation lets XLA update in place
+    return jax.jit(f, donate_argnums=0)
 
 
 def make_pjit_train_step(config: RAFTConfig, tconfig: TrainConfig, tx,
@@ -40,7 +45,9 @@ def make_pjit_train_step(config: RAFTConfig, tconfig: TrainConfig, tx,
     over ``data_axis`` on B and optionally ``spatial_axis`` on H; params and
     optimizer state replicated.  XLA's SPMD partitioner inserts the gradient
     all-reduce, the conv halo exchanges, and the correlation collectives.
-    Complements the explicit shard_map path (make_dp_train_step)."""
+    Complements the explicit shard_map path (make_dp_train_step).
+
+    The input state is DONATED (consumed), as in make_dp_train_step."""
     from jax.sharding import NamedSharding
 
     inner = make_train_step(config, tconfig, tx, axis_name=None)
@@ -50,7 +57,8 @@ def make_pjit_train_step(config: RAFTConfig, tconfig: TrainConfig, tx,
     batch_shardings = Batch(img, img, planar, planar)
     return jax.jit(inner,
                    in_shardings=(rep, batch_shardings, rep),
-                   out_shardings=(rep, rep))
+                   out_shardings=(rep, rep),
+                   donate_argnums=0)
 
 
 def make_dp_eval_fn(config: RAFTConfig, mesh: Mesh,

@@ -49,7 +49,10 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
         step_fn = make_dp_train_step(config, tconfig, tx, mesh)
         log_fn(f"[train] data-parallel over {n_dev} devices")
     else:
-        step_fn = jax.jit(make_train_step(config, tconfig, tx))
+        # donate the input state (the loop rebinds it every step; XLA
+        # updates the buffers in place)
+        step_fn = jax.jit(make_train_step(config, tconfig, tx),
+                          donate_argnums=0)
 
     start_step = 0
     if ckpt_dir and resume:

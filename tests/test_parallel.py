@@ -48,7 +48,10 @@ def test_dp_train_step_matches_single_device():
     mesh = make_mesh()
     dp = make_dp_train_step(config, tconfig, tx, mesh)
     sharded = shard_batch(mesh, batch)
-    s8, m8 = dp(state, sharded, rng)
+    # dp donates (consumes) its input state; give it its own copy since
+    # `state` is compared against afterwards via s1
+    state_dp = jax.tree.map(jnp.copy, state)
+    s8, m8 = dp(state_dp, sharded, rng)
 
     # pmean of per-shard grads == global grad (equal shard sizes, mean loss)
     np.testing.assert_allclose(float(m1["loss"]), float(m8["loss"]), rtol=1e-4)
