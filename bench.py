@@ -283,7 +283,7 @@ def _run(args, t_start: float, result: dict) -> None:
     # batches raise MXU utilization and pairs/sec/chip)
     if best_name is not None and B == 1:
         cfg = _cfg_for(best_name.split("+")[0])
-        for nb in (4, 8):
+        for nb in (4, 8, 16):
             if time.perf_counter() - t_start > args.budget:
                 print(f"# budget exceeded; skipping batch {nb}", file=sys.stderr)
                 break
