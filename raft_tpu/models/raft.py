@@ -134,12 +134,25 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
 
     if spmd.spatial_axis() is not None:
         # row-sharded run (make_shard_inference_fn): correlation must see the
-        # full fmap2, which lives sharded across devices -> ring pass
+        # full fmap2, which lives sharded across devices -> ring pass; with
+        # corr_impl='pallas' each slab's partial rides the fused kernel
         from ..parallel.spatial import make_ring_lookup_local
-        lookup = make_ring_lookup_local(fmap1c, fmap2c, config.corr_levels,
-                                        config.corr_radius,
-                                        spmd.spatial_axis(),
-                                        precision=corr_prec)
+        if config.corr_impl == "pallas":
+            try:
+                from ..ops import corr_pallas  # noqa: F401 — availability check
+            except ImportError as e:
+                raise NotImplementedError(
+                    "corr_impl='pallas' requires ops/corr_pallas.py (the "
+                    "fused TPU kernel); use 'dense' or 'blockwise'.") from e
+        lookup = make_ring_lookup_local(
+            fmap1c, fmap2c, config.corr_levels, config.corr_radius,
+            spmd.spatial_axis(), precision=corr_prec,
+            kernel="pallas" if config.corr_impl == "pallas" else "onehot",
+            pallas_opts=dict(q_blk=config.pallas_q_blk,
+                             p_blk_target=config.pallas_p_blk,
+                             lookup_style=config.pallas_lookup_style,
+                             p_select=config.pallas_p_select,
+                             pack_rows=config.pallas_pack))
     elif config.corr_impl == "dense":
         lookup_fn = (lookup_dense_onehot if config.corr_lookup == "onehot"
                      else lookup_dense)

@@ -68,7 +68,8 @@ def make_spatial_corr_lookup(mesh: Mesh, num_levels: int, radius: int,
 
 def make_ring_lookup_local(f1_local: jax.Array, f2_local: jax.Array,
                            num_levels: int, radius: int, axis: str,
-                           precision=None):
+                           precision=None, kernel: str = "onehot",
+                           pallas_opts: Optional[dict] = None):
     """Build a per-iteration ring-pass correlation lookup closure for use
     INSIDE an existing shard_map over ``axis`` (fmap1/fmap2/coords all
     row-sharded slabs, coords in global pixel units).
@@ -79,7 +80,18 @@ def make_ring_lookup_local(f1_local: jax.Array, f2_local: jax.Array,
     slab, so partials sum exactly), and ``ppermute`` the slab pyramid to the
     next neighbor — n-1 rotations, compute overlapping the ICI transfer,
     peak memory O((HW)^2/n^2) per device.
+
+    ``kernel``: 'onehot' computes each slab's partial via dense_corr + the
+    XLA one-hot lookup; 'pallas' runs the fused kernel per slab — shifting
+    the global query coords down by the slab's start row makes the
+    unchanged kernel produce exactly that slab's partial at EVERY pyramid
+    level at once (the shift scales with the level like the coords do, and
+    out-of-slab windows one-hot-match nothing = zeros).  ``pallas_opts``
+    forwards q_blk/p_blk_target/lookup_style/p_select/pack_rows.
     """
+    if kernel not in ("onehot", "pallas"):
+        raise ValueError(f"kernel must be 'onehot' or 'pallas', "
+                         f"got {kernel!r}")
     n_dev = jax.lax.axis_size(axis)
     my = jax.lax.axis_index(axis)
     B, Hl, W, C = f1_local.shape
@@ -96,6 +108,27 @@ def make_ring_lookup_local(f1_local: jax.Array, f2_local: jax.Array,
         flat = coords.reshape(B, Q, 2)
 
         def contrib(levels, src):
+            if kernel == "pallas":
+                # public custom_vjp entry point: the ring path stays
+                # differentiable (backward rides the XLA twin)
+                from ..ops.corr_pallas import fused_lookup
+                opts = {"q_blk": 128, "p_blk_target": 4096,
+                        "lookup_style": "matmul", "p_select": "all",
+                        "pack_rows": False, **(pallas_opts or {})}
+                # global -> slab-local coords: subtract the slab's start row
+                # (src * Hl full-res fmap rows); the kernel's own 1/2^i
+                # scaling then lands on the right slab row at every level
+                shifted = coords.at[..., 1].add(
+                    -(src * Hl).astype(coords.dtype))
+                # precision=None means backend default — same resolution the
+                # onehot branch's dense_corr applies
+                prec = (precision if precision is not None
+                        else jax.lax.Precision.DEFAULT)
+                out = fused_lookup(f1_local, tuple(levels), shifted, radius,
+                                   prec, opts["q_blk"], opts["p_blk_target"],
+                                   opts["lookup_style"], opts["p_select"],
+                                   opts["pack_rows"])
+                return out.reshape(B, Q, -1)
             outs = []
             for i, f2l in enumerate(levels):
                 H2l = f2l.shape[1]
