@@ -98,6 +98,17 @@ def test_full_model_torch_parity_pallas_winpack():
     assert err <= 1e-3 + 1e-3 * scale, (err, scale)
 
 
+def test_small_model_torch_parity_pallas():
+    """raft-small (r=3, ConvGRU, bilinear upflow) through the fused kernel
+    must match the official torch model too — golden coverage for the
+    radius-3 window family."""
+    tflows, jflows = _run_pair(True, B=1, H=128, W=128, iters=2,
+                               corr_impl="pallas")
+    err = np.abs(tflows[-1] - jflows[-1]).max()
+    scale = np.abs(tflows[-1]).max()
+    assert err <= 1e-3 + 1e-3 * scale, (err, scale)
+
+
 def test_official_state_dict_shape_contract():
     """The official checkpoints carry DataParallel 'module.' prefixes,
     num_batches_tracked counters, and aliased shortcut norms — the converter
