@@ -50,6 +50,36 @@ def _apply_jpeg(im: np.ndarray, quality: int) -> np.ndarray:
     return cv2.imdecode(enc, cv2.IMREAD_COLOR).astype(np.float32)
 
 
+def _paired_color(rng: np.random.RandomState, im1: np.ndarray,
+                  im2: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Contrast/gamma/brightness with the SAME draw applied to both frames
+    (shared by the dense and sparse flow augmentors)."""
+    contrast = rng.uniform(0.8, 1.2)
+    gamma = rng.uniform(-0.2, 0.2)
+    brightness = rng.uniform(-20, 20)
+    for f in ((lambda x: _apply_contrast(x, contrast)),
+              (lambda x: _apply_gamma(x, gamma)),
+              (lambda x: np.clip(x + brightness, 0, 255))):
+        im1, im2 = f(im1), f(im2)
+    return im1, im2
+
+
+def _occlusion_eraser(rng: np.random.RandomState, im2: np.ndarray,
+                      prob: float) -> np.ndarray:
+    """With probability ``prob``, paint 1-2 random mean-color rectangles onto
+    frame 2 (synthetic occlusions; shared by both flow augmentors)."""
+    if rng.rand() < prob:
+        h, w = im2.shape[:2]
+        mean = im2.reshape(-1, 3).mean(0)
+        for _ in range(rng.randint(1, 3)):
+            x0 = rng.randint(0, w)
+            y0 = rng.randint(0, h)
+            dx = rng.randint(50, 100)
+            dy = rng.randint(50, 100)
+            im2[y0:y0 + dy, x0:x0 + dx] = mean
+    return im2
+
+
 class PairAugmentor:
     """Reference FlowDataProcess semantics (paired params, no flow)."""
 
@@ -107,27 +137,86 @@ class PairAugmentor:
         return im1 / 255.0, im2 / 255.0
 
 
+def resample_sparse_flow(flow: np.ndarray, valid: np.ndarray,
+                         sx: float, sy: float
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Valid-aware resampling of a sparse flow map to scale (sx, sy).
+
+    Dense interpolation (cv2.resize) is wrong for sparse ground truth: it
+    blends measured pixels with the zeros that mark holes.  Instead, scatter:
+    take each VALID sample, move its coordinate to (round(x*sx), round(y*sy)),
+    scale its flow value by (sx, sy), and write it into a fresh map; output
+    pixels that receive no sample stay invalid (official RAFT
+    ``resize_sparse_flow_map`` semantics — the capability the TF1 reference
+    never had, since it never handled flow at all).  Collisions (two samples
+    rounding to one target pixel) keep the last write, matching the official
+    scatter behavior.
+    """
+    h, w = flow.shape[:2]
+    nh, nw = int(round(h * sy)), int(round(w * sx))
+    ys, xs = np.nonzero(valid >= 0.5)
+    x1 = np.round(xs * sx).astype(np.int64)
+    y1 = np.round(ys * sy).astype(np.int64)
+    keep = (x1 >= 0) & (x1 < nw) & (y1 >= 0) & (y1 < nh)
+    out_flow = np.zeros((nh, nw, 2), np.float32)
+    out_valid = np.zeros((nh, nw), np.float32)
+    out_flow[y1[keep], x1[keep], 0] = flow[ys[keep], xs[keep], 0] * sx
+    out_flow[y1[keep], x1[keep], 1] = flow[ys[keep], xs[keep], 1] * sy
+    out_valid[y1[keep], x1[keep]] = 1.0
+    return out_flow, out_valid
+
+
 class SparseFlowAugmentor:
-    """Augmentation for sparse ground truth (KITTI): random crop + horizontal
-    flip only, transforming the validity mask alongside the flow.  No
-    rescaling in round 1 — sparse flow resampling needs valid-aware
-    scattering.  Pads with replicate if a frame is smaller than the crop."""
+    """Augmentation for sparse ground truth (KITTI): paired photometric,
+    random scale (valid-aware sparse flow scatter — see
+    :func:`resample_sparse_flow`), horizontal flip, random crop, occlusion
+    eraser — the official RAFT KITTI-finetune recipe (no stretch for sparse
+    data, matching the official sparse augmentor).  Transforms the validity
+    mask alongside the flow throughout.  Pads with replicate if a frame is
+    smaller than the crop."""
 
     accepts_valid = True
 
     def __init__(self, crop_size: Tuple[int, int], do_flip: bool = True,
+                 min_scale: float = -0.2, max_scale: float = 0.4,
+                 spatial_prob: float = 0.8, photometric: bool = True,
+                 eraser_prob: float = 0.5,
                  rng: Optional[np.random.RandomState] = None):
         self.crop_size = tuple(crop_size)
         self.do_flip = do_flip
+        self.min_scale = min_scale
+        self.max_scale = max_scale
+        self.spatial_prob = spatial_prob
+        self.photometric = photometric
+        self.eraser_prob = eraser_prob
         self.rng = rng or np.random.RandomState()
 
     def __call__(self, im1, im2, flow, valid):
+        import cv2
         rng = self.rng
         ch, cw = self.crop_size
         im1 = im1.astype(np.float32)
         im2 = im2.astype(np.float32)
         flow = flow.astype(np.float32)
         valid = valid.astype(np.float32)
+
+        if self.photometric:
+            im1, im2 = _paired_color(rng, im1, im2)
+
+        # random scale: images resize densely, flow+valid scatter sparsely.
+        # Clamp so the scaled frame still contains the crop window.
+        h, w = im1.shape[:2]
+        scale_floor = max((ch + 1) / float(h), (cw + 1) / float(w))
+        scale = 2.0 ** rng.uniform(self.min_scale, self.max_scale)
+        scale = max(scale, scale_floor)
+        if rng.rand() < self.spatial_prob and scale != 1.0:
+            nh, nw = int(round(h * scale)), int(round(w * scale))
+            im1 = cv2.resize(im1, (nw, nh), interpolation=cv2.INTER_LINEAR)
+            im2 = cv2.resize(im2, (nw, nh), interpolation=cv2.INTER_LINEAR)
+            flow, valid = resample_sparse_flow(flow, valid, scale, scale)
+            # cv2.resize rounds independently of resample_sparse_flow; both
+            # use round(), so the shapes agree
+            assert flow.shape[:2] == im1.shape[:2], (flow.shape, im1.shape)
 
         ph = max(ch - im1.shape[0], 0)
         pw = max(cw - im1.shape[1], 0)
@@ -146,8 +235,10 @@ class SparseFlowAugmentor:
         y0 = rng.randint(0, im1.shape[0] - ch + 1)
         x0 = rng.randint(0, im1.shape[1] - cw + 1)
         sl = np.s_[y0:y0 + ch, x0:x0 + cw]
+        im2c = _occlusion_eraser(rng, np.ascontiguousarray(im2[sl]),
+                                 self.eraser_prob)
         return (np.ascontiguousarray(im1[sl]) / 255.0,
-                np.ascontiguousarray(im2[sl]) / 255.0,
+                im2c / 255.0,
                 np.ascontiguousarray(flow[sl]),
                 np.ascontiguousarray(valid[sl]))
 
@@ -171,31 +262,6 @@ class FlowAugmentor:
         self.eraser_prob = eraser_prob
         self.photometric = photometric
         self.rng = rng or np.random.RandomState()
-
-    # -- photometric: paired core + asymmetric jitter
-    def _color(self, im1, im2):
-        rng = self.rng
-        contrast = rng.uniform(0.8, 1.2)
-        gamma = rng.uniform(-0.2, 0.2)
-        brightness = rng.uniform(-20, 20)
-        for f in ((lambda x: _apply_contrast(x, contrast)),
-                  (lambda x: _apply_gamma(x, gamma)),
-                  (lambda x: np.clip(x + brightness, 0, 255))):
-            im1, im2 = f(im1), f(im2)
-        return im1, im2
-
-    def _eraser(self, im2):
-        rng = self.rng
-        if rng.rand() < self.eraser_prob:
-            h, w = im2.shape[:2]
-            mean = im2.reshape(-1, 3).mean(0)
-            for _ in range(rng.randint(1, 3)):
-                x0 = rng.randint(0, w)
-                y0 = rng.randint(0, h)
-                dx = rng.randint(50, 100)
-                dy = rng.randint(50, 100)
-                im2[y0:y0 + dy, x0:x0 + dx] = mean
-        return im2
 
     def _spatial(self, im1, im2, flow):
         import cv2
@@ -243,9 +309,10 @@ class FlowAugmentor:
         im2 = im2.astype(np.float32)
         flow = flow.astype(np.float32)
         if self.photometric:
-            im1, im2 = self._color(im1, im2)
+            im1, im2 = _paired_color(self.rng, im1, im2)
         im1, im2, flow = self._spatial(im1, im2, flow)
-        im2 = self._eraser(np.ascontiguousarray(im2))
+        im2 = _occlusion_eraser(self.rng, np.ascontiguousarray(im2),
+                                self.eraser_prob)
         im1 = np.ascontiguousarray(im1) / 255.0
         im2 = np.ascontiguousarray(im2) / 255.0
         flow = np.ascontiguousarray(flow)

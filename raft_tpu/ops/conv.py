@@ -70,7 +70,7 @@ def apply_conv(params: dict, x: jax.Array, stride: int = 1, compute_dtype=None) 
 
 
 def apply_conv_fused(params_list: Sequence[dict], x: jax.Array,
-                     stride: int = 1) -> Tuple[jax.Array, ...]:
+                     stride: int = 1, compute_dtype=None) -> Tuple[jax.Array, ...]:
     """Run several same-input, same-kernel-size convolutions as ONE conv.
 
     Convolution is linear in the kernel, so concatenating the output-channel
@@ -85,7 +85,7 @@ def apply_conv_fused(params_list: Sequence[dict], x: jax.Array,
     bs = [p.get("b") for p in params_list]
     fuse_bias = all(b_ is not None for b_ in bs)
     out = conv2d(x, w, jnp.concatenate(bs) if fuse_bias else None,
-                 stride=stride)
+                 stride=stride, compute_dtype=compute_dtype)
     splits, start = [], 0
     for p in params_list:
         c = p["w"].shape[3]

@@ -21,12 +21,16 @@ from .mesh import DATA_AXIS
 
 
 def make_dp_train_step(config: RAFTConfig, tconfig: TrainConfig, tx,
-                       mesh: Mesh, axis: str = DATA_AXIS):
+                       mesh: Mesh, axis: str = DATA_AXIS,
+                       donate: bool = True):
     """Returns jitted (state, batch, rng) -> (state, metrics) with the batch
     sharded over ``axis`` and state replicated.
 
-    The input state is DONATED (consumed): rebind ``state = step(state, ...)``
-    and never reuse the old one — reuse raises 'Array has been deleted'."""
+    With ``donate=True`` (default) the input state is DONATED (consumed):
+    rebind ``state = step(state, ...)`` and never reuse the old one — reuse
+    raises 'Array has been deleted'.  Pass ``donate=False`` to keep the old
+    state alive (e.g. for step-to-step comparisons), at the cost of a second
+    in-flight copy of params+optimizer state."""
     inner = make_train_step(config, tconfig, tx, axis_name=axis)
     batch_spec = Batch(P(axis), P(axis), P(axis), P(axis))
     f = jax.shard_map(inner, mesh=mesh,
@@ -35,19 +39,21 @@ def make_dp_train_step(config: RAFTConfig, tconfig: TrainConfig, tx,
                       check_vma=False)
     # donate the input state: the loop rebinds `state = step(state, ...)`,
     # so the old buffers are dead — donation lets XLA update in place
-    return jax.jit(f, donate_argnums=0)
+    return jax.jit(f, donate_argnums=0 if donate else ())
 
 
 def make_pjit_train_step(config: RAFTConfig, tconfig: TrainConfig, tx,
                          mesh: Mesh, data_axis: str = DATA_AXIS,
-                         spatial_axis: Optional[str] = None):
+                         spatial_axis: Optional[str] = None,
+                         donate: bool = True):
     """Train step via jit sharding annotations (the pjit path): batch sharded
     over ``data_axis`` on B and optionally ``spatial_axis`` on H; params and
     optimizer state replicated.  XLA's SPMD partitioner inserts the gradient
     all-reduce, the conv halo exchanges, and the correlation collectives.
     Complements the explicit shard_map path (make_dp_train_step).
 
-    The input state is DONATED (consumed), as in make_dp_train_step."""
+    The input state is DONATED (consumed), as in make_dp_train_step;
+    ``donate=False`` opts out."""
     from jax.sharding import NamedSharding
 
     inner = make_train_step(config, tconfig, tx, axis_name=None)
@@ -58,7 +64,7 @@ def make_pjit_train_step(config: RAFTConfig, tconfig: TrainConfig, tx,
     return jax.jit(inner,
                    in_shardings=(rep, batch_shardings, rep),
                    out_shardings=(rep, rep),
-                   donate_argnums=0)
+                   donate_argnums=0 if donate else ())
 
 
 def make_dp_eval_fn(config: RAFTConfig, mesh: Mesh,

@@ -87,11 +87,28 @@ def make_ring_lookup_local(f1_local: jax.Array, f2_local: jax.Array,
     unchanged kernel produce exactly that slab's partial at EVERY pyramid
     level at once (the shift scales with the level like the coords do, and
     out-of-slab windows one-hot-match nothing = zeros).  ``pallas_opts``
-    forwards q_blk/p_blk_target/lookup_style/p_select/pack_rows.
+    forwards q_blk/p_blk_target/lookup_style/p_select/pack_rows; note
+    p_select='window' wants a small p_blk_target (the config.py comment on
+    pallas_p_blk_target applies to the ring path too).
+
+    ``precision=None`` means backend-default MXU precision (bf16 inputs) on
+    BOTH branches: dense_corr passes it through, and the pallas branch maps
+    it to ``jax.lax.Precision.DEFAULT``.
     """
     if kernel not in ("onehot", "pallas"):
         raise ValueError(f"kernel must be 'onehot' or 'pallas', "
                          f"got {kernel!r}")
+    if kernel == "pallas":
+        # public custom_vjp entry point: the ring path stays differentiable
+        # (backward rides the XLA twin); hoisted out of the per-slab closure
+        from ..ops.corr_pallas import fused_lookup
+        pl_opts = {"q_blk": 128, "p_blk_target": 4096,
+                   "lookup_style": "matmul", "p_select": "all",
+                   "pack_rows": False, **(pallas_opts or {})}
+        # precision=None means backend default — same resolution the onehot
+        # branch's dense_corr applies
+        pl_prec = (precision if precision is not None
+                   else jax.lax.Precision.DEFAULT)
     n_dev = jax.lax.axis_size(axis)
     my = jax.lax.axis_index(axis)
     B, Hl, W, C = f1_local.shape
@@ -109,25 +126,16 @@ def make_ring_lookup_local(f1_local: jax.Array, f2_local: jax.Array,
 
         def contrib(levels, src):
             if kernel == "pallas":
-                # public custom_vjp entry point: the ring path stays
-                # differentiable (backward rides the XLA twin)
-                from ..ops.corr_pallas import fused_lookup
-                opts = {"q_blk": 128, "p_blk_target": 4096,
-                        "lookup_style": "matmul", "p_select": "all",
-                        "pack_rows": False, **(pallas_opts or {})}
                 # global -> slab-local coords: subtract the slab's start row
                 # (src * Hl full-res fmap rows); the kernel's own 1/2^i
                 # scaling then lands on the right slab row at every level
                 shifted = coords.at[..., 1].add(
                     -(src * Hl).astype(coords.dtype))
-                # precision=None means backend default — same resolution the
-                # onehot branch's dense_corr applies
-                prec = (precision if precision is not None
-                        else jax.lax.Precision.DEFAULT)
                 out = fused_lookup(f1_local, tuple(levels), shifted, radius,
-                                   prec, opts["q_blk"], opts["p_blk_target"],
-                                   opts["lookup_style"], opts["p_select"],
-                                   opts["pack_rows"])
+                                   pl_prec, pl_opts["q_blk"],
+                                   pl_opts["p_blk_target"],
+                                   pl_opts["lookup_style"],
+                                   pl_opts["p_select"], pl_opts["pack_rows"])
                 return out.reshape(B, Q, -1)
             outs = []
             for i, f2l in enumerate(levels):
@@ -157,14 +165,22 @@ def make_ring_lookup_local(f1_local: jax.Array, f2_local: jax.Array,
 
 
 def make_ring_corr_lookup(mesh: Mesh, num_levels: int, radius: int,
-                          axis: str = SPATIAL_AXIS):
+                          axis: str = SPATIAL_AXIS, precision=None,
+                          kernel: str = "onehot",
+                          pallas_opts: Optional[dict] = None):
     """Standalone jitted ring-pass correlation lookup — the ring-attention
     analog (see :func:`make_ring_lookup_local`): (fmap1, fmap2, coords) ->
-    [B, H, W, L*(2r+1)^2], all arrays row-sharded over ``axis``."""
+    [B, H, W, L*(2r+1)^2], all arrays row-sharded over ``axis``.
+
+    ``precision`` / ``kernel`` / ``pallas_opts`` forward to
+    :func:`make_ring_lookup_local` with the same semantics, so the standalone
+    entry point exposes the full option surface of the in-model ring path."""
 
     def inner(f1_local, f2_local, coords_local):
         lookup = make_ring_lookup_local(f1_local, f2_local, num_levels,
-                                        radius, axis)
+                                        radius, axis, precision=precision,
+                                        kernel=kernel,
+                                        pallas_opts=pallas_opts)
         return lookup(coords_local)
 
     f = jax.shard_map(inner, mesh=mesh,

@@ -32,6 +32,7 @@ from .step import make_eval_step
 def evaluate_dataset(params, config: RAFTConfig, dataset,
                      iters: Optional[int] = None, max_samples: Optional[int] = None,
                      pad_mode: str = "sintel", bucket: int = 8,
+                     weighting: str = "sample",
                      verbose: bool = True) -> Dict[str, float]:
     """dataset yields (im1, im2, flow_gt, valid) numpy samples (augmentor=None).
 
@@ -40,8 +41,19 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
     default 8 is the official InputPadder protocol (minimal /8 padding) —
     right for single-shape datasets like Sintel, where coarser padding would
     shift border predictions and hence EPE.  Pass 64 for per-image-size
-    datasets (KITTI: 370-376 x 1224-1242 all collapse onto one compile)."""
+    datasets (KITTI: 370-376 x 1224-1242 all collapse onto one compile).
+
+    ``weighting``: how metrics aggregate across images.  ``"sample"`` averages
+    per-image means (every image weighs equally — matches the official Sintel
+    protocol and this repo's historical numbers).  ``"pixel"`` pools valid
+    pixels across the whole dataset before dividing — the official KITTI
+    convention for Fl-all/EPE, where images with more valid ground-truth
+    pixels weigh more; with per-image-variable valid counts the two differ.
+    """
     assert bucket % 8 == 0 and bucket > 0, bucket
+    if weighting not in ("sample", "pixel"):
+        raise ValueError(f"weighting must be 'sample' or 'pixel', "
+                         f"got {weighting!r}")
     eval_fn = jax.jit(make_eval_step(config, iters=iters))
     sums: Dict[str, float] = {}
     count = 0
@@ -55,14 +67,21 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
         shapes_seen.add(im1p.shape)
         flow = np.asarray(eval_fn(params, jnp.asarray(im1p), jnp.asarray(im2p)))
         flow = unpad(flow, pads)[0]
-        m = jax.device_get(epe_metrics(jnp.asarray(flow), jnp.asarray(flow_gt),
-                                       jnp.asarray(valid)))
+        m = jax.device_get(epe_metrics(
+            jnp.asarray(flow), jnp.asarray(flow_gt), jnp.asarray(valid),
+            reduce="sum" if weighting == "pixel" else "mean"))
         for k, v in m.items():
             sums[k] = sums.get(k, 0.0) + float(v)
         count += 1
         if verbose and (idx + 1) % 50 == 0:
-            print(f"  eval {idx + 1}/{n}  epe so far {sums['epe'] / count:.3f}")
-    out = {k: v / max(count, 1) for k, v in sums.items()}
+            running = (sums["epe"] / max(sums.get("valid_px", 1.0), 1.0)
+                       if weighting == "pixel" else sums["epe"] / count)
+            print(f"  eval {idx + 1}/{n}  epe so far {running:.3f}")
+    if weighting == "pixel":
+        denom = max(sums.pop("valid_px", 0.0), 1.0)
+        out = {k: v / denom for k, v in sums.items()}
+    else:
+        out = {k: v / max(count, 1) for k, v in sums.items()}
     out["samples"] = count
     out["seconds"] = time.time() - t0
     # one XLA compile per distinct padded shape — the observable the bucketing
@@ -110,8 +129,13 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
         return 2
     if getattr(args, "bucket", None) is not None:
         bucket = args.bucket
+    # official protocols: KITTI pools valid pixels across images; Sintel and
+    # the dense datasets average per-image means
+    weighting = getattr(args, "weighting", None) or (
+        "pixel" if args.dataset == "kitti" else "sample")
     metrics = evaluate_dataset(params, config, ds, iters=args.iters,
-                               pad_mode=pad_mode, bucket=bucket)
+                               pad_mode=pad_mode, bucket=bucket,
+                               weighting=weighting)
     name = f"{args.dataset} ({'small' if args.small else 'full'})"
     print(f"[val] {name}: " + "  ".join(
         f"{k}={v:.4f}" for k, v in metrics.items()))

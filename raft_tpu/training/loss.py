@@ -48,19 +48,33 @@ def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array,
 
 
 def epe_metrics(flow_pred: jax.Array, flow_gt: jax.Array,
-                valid: Optional[jax.Array] = None) -> Dict[str, jax.Array]:
+                valid: Optional[jax.Array] = None,
+                reduce: str = "mean") -> Dict[str, jax.Array]:
     """End-point-error statistics for evaluation (the measurement harness the
-    reference never had, SURVEY.md §6)."""
+    reference never had, SURVEY.md §6).
+
+    ``reduce="mean"`` returns per-call means over valid pixels (per-image
+    averaging).  ``reduce="sum"`` returns the unnormalized valid-masked sums
+    plus a ``valid_px`` count, so a caller can pool valid *pixels* across
+    images — the official KITTI Fl-all/EPE convention, where images with more
+    valid pixels weigh more.
+    """
+    if reduce not in ("mean", "sum"):
+        raise ValueError(f"reduce must be 'mean' or 'sum', got {reduce!r}")
     epe = jnp.linalg.norm(flow_pred - flow_gt, axis=-1)
     v = jnp.ones_like(epe) if valid is None else valid.astype(jnp.float32)
-    denom = jnp.maximum(v.sum(), 1.0)
     mag = jnp.maximum(jnp.linalg.norm(flow_gt, axis=-1), 1e-6)
     # KITTI Fl-all: error > 3px AND > 5% of magnitude
     fl = ((epe > 3.0) & (epe / mag > 0.05)).astype(jnp.float32)
-    return {
-        "epe": (epe * v).sum() / denom,
-        "1px": ((epe < 1.0) * v).sum() / denom,
-        "3px": ((epe < 3.0) * v).sum() / denom,
-        "5px": ((epe < 5.0) * v).sum() / denom,
-        "fl_all": (fl * v).sum() / denom,
+    sums = {
+        "epe": (epe * v).sum(),
+        "1px": ((epe < 1.0) * v).sum(),
+        "3px": ((epe < 3.0) * v).sum(),
+        "5px": ((epe < 5.0) * v).sum(),
+        "fl_all": (fl * v).sum(),
     }
+    if reduce == "sum":
+        sums["valid_px"] = v.sum()
+        return sums
+    denom = jnp.maximum(v.sum(), 1.0)
+    return {k: s / denom for k, s in sums.items()}

@@ -149,3 +149,13 @@ def test_apply_conv_fused_matches_separate():
     for got, p in zip(outs, (p1, p2, p3)):
         np.testing.assert_allclose(np.asarray(got),
                                    np.asarray(apply_conv(p, x)), atol=1e-6)
+
+    # the fused and separate paths must stay interchangeable under a
+    # compute_dtype override too (same casts on both sides)
+    outs_bf = apply_conv_fused((p1, p2, p3), x,
+                               compute_dtype=jnp.bfloat16)
+    for got, p in zip(outs_bf, (p1, p2, p3)):
+        want = apply_conv(p, x, compute_dtype=jnp.bfloat16)
+        assert got.dtype == want.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32), atol=1e-6)
