@@ -19,7 +19,6 @@ for --small (infer_raft.py:44); here the name follows the variant.
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 import time
 from pathlib import Path
@@ -62,6 +61,12 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data", default=None, help="dataset root directory")
     p.add_argument("--dataset", default="sintel",
                    choices=["sintel", "chairs", "things", "kitti", "synthetic"])
+    p.add_argument("--weighting", default=None,
+                   choices=["sample", "pixel"],
+                   help="val-mode metric aggregation: 'sample' averages "
+                        "per-image means (Sintel protocol), 'pixel' pools "
+                        "valid pixels across images (official KITTI "
+                        "convention; default for --dataset kitti)")
     p.add_argument("--bucket", type=int, default=None,
                    help="val-mode resolution bucket (pad H,W to this "
                         "multiple; default: 8, the InputPadder protocol, or "
@@ -83,6 +88,19 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("-o", "--optimizer", default="adamw",
                    choices=["adam", "adamw", "sgd", "sgd_cyclic", "sgd_1cycle"])
     p.add_argument("--lr", type=float, default=None)
+    # multi-host (multi-process) coordination over DCN: the same command line
+    # runs unchanged on a v4-32 pod slice — one process per host, e.g.
+    #   python -m raft_tpu.cli -m train --coordinator host0:1234 \
+    #       --num-processes 4 --process-id $WORKER_ID ...
+    # (env fallbacks RAFT_TPU_COORDINATOR / RAFT_TPU_NUM_PROCESSES /
+    # RAFT_TPU_PROCESS_ID let launchers avoid per-host argv edits)
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="multi-host train: coordinator address for "
+                        "jax.distributed.initialize")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="multi-host train: total process count")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="multi-host train: this process's rank")
     return p
 
 
@@ -250,6 +268,14 @@ def main(argv=None) -> int:
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
+    if args.mode == "train":
+        # must run before anything touches a device: jax.distributed connects
+        # the processes and makes jax.devices() span every host (env
+        # fallbacks for all three args live inside initialize)
+        from .parallel.distributed import initialize
+        initialize(coordinator_address=args.coordinator,
+                   num_processes=args.num_processes,
+                   process_id=args.process_id)
     return {"test": mode_test, "flops": mode_flops, "export": mode_export,
             "val": mode_val, "train": mode_train}[args.mode](args)
 

@@ -20,11 +20,20 @@ def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None) -> None:
     """Initialize multi-host JAX.  No-ops cleanly for single-process runs
-    (and under test environments without a coordinator)."""
+    (and under test environments without a coordinator).
+
+    Every argument left None falls back to its RAFT_TPU_* env var
+    (RAFT_TPU_COORDINATOR / RAFT_TPU_NUM_PROCESSES / RAFT_TPU_PROCESS_ID),
+    so launchers can configure the whole trio without per-host argv edits —
+    for the CLI and library callers alike."""
     if num_processes is None:
         num_processes = int(os.environ.get("RAFT_TPU_NUM_PROCESSES", "1"))
     if num_processes <= 1:
         return
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("RAFT_TPU_COORDINATOR")
+    if process_id is None and "RAFT_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["RAFT_TPU_PROCESS_ID"])
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

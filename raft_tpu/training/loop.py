@@ -37,12 +37,40 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
     params = init_raft(key, config)
     state = TrainState.create(params, tx)
 
+    # multi-host: every process runs this same loop; jax.devices() spans all
+    # hosts once parallel.distributed.initialize has connected them (the
+    # runnable replacement for the reference's implied-but-dead multi-GPU
+    # stack, reference infer_raft.py:13 / SURVEY.md §2.3)
+    multihost = jax.process_count() > 1
+    is_main = jax.process_index() == 0
     n_dev = len(jax.devices())
+    mh_mesh = None
+    mh_assemble = None
+    if multihost and not data_parallel:
+        raise ValueError("multi-host training is inherently data-parallel; "
+                         "pass data_parallel=True (or run single-process)")
+    if multihost and tconfig.batch_size % n_dev != 0:
+        raise ValueError(
+            f"multi-host training requires global batch "
+            f"{tconfig.batch_size} divisible by {n_dev} global devices")
     if data_parallel and n_dev > 1 and tconfig.batch_size % n_dev != 0:
         log_fn(f"[train] batch {tconfig.batch_size} not divisible by "
                f"{n_dev} devices; falling back to single-device")
         data_parallel = False
-    if data_parallel and n_dev > 1:
+    if multihost:
+        from jax.sharding import PartitionSpec
+        from ..parallel.data_parallel import make_pjit_train_step
+        from ..parallel.distributed import assemble_global_array, global_mesh
+        mh_mesh = global_mesh()
+
+        def mh_assemble(x, spec=PartitionSpec("data")):
+            return assemble_global_array(np.asarray(x), mh_mesh, spec)
+
+        step_fn = make_pjit_train_step(config, tconfig, tx, mh_mesh)
+        log_fn(f"[train] multi-host: {jax.process_count()} processes x "
+               f"{jax.local_device_count()} local devices "
+               f"(global batch {tconfig.batch_size})")
+    elif data_parallel and n_dev > 1:
         from ..parallel.data_parallel import make_dp_train_step
         from ..parallel.mesh import make_mesh
         mesh = make_mesh()
@@ -62,6 +90,26 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
             start_step = int(state.step)
             log_fn(f"[train] resumed from {latest} at step {start_step}")
 
+    if multihost:
+        # only process 0 writes checkpoints, so a resume is consistent only
+        # when every process restored the SAME state (shared filesystem, or
+        # checkpoints copied to every host).  A divergent resume (e.g.
+        # per-host --out dirs where only host 0 has checkpoints) would build
+        # inconsistent 'replicated' state and train garbage — fail loudly
+        # instead.
+        from jax.experimental import multihost_utils
+        steps = multihost_utils.process_allgather(np.int64(start_step))
+        if len(set(int(s) for s in steps)) != 1:
+            raise RuntimeError(
+                f"inconsistent multi-host resume: per-process restored steps "
+                f"{[int(s) for s in steps]}; point every process at the same "
+                f"checkpoint directory (shared filesystem)")
+        # promote the (identical-on-every-host: same seed init, same restored
+        # checkpoint) host-local state to replicated global arrays on the
+        # cross-host mesh; batches are assembled per step below
+        state = jax.tree.map(
+            lambda x: mh_assemble(x, jax.sharding.PartitionSpec()), state)
+
     # profiler window: steps 5-8 inclusive relative to start (post-compile,
     # steady state; stop fires when step reaches the exclusive end) — the
     # jax.profiler replacement for the reference's tf.profiler
@@ -74,7 +122,7 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
     # the reference's never-used add_moving_summary import, reference
     # RAFT.py:6 / SURVEY.md §5)
     metrics_path = Path(ckpt_dir) / "metrics.jsonl" if ckpt_dir else None
-    if metrics_path:
+    if metrics_path and is_main:
         metrics_path.parent.mkdir(parents=True, exist_ok=True)
         if metrics_path.exists():
             # a crash between a logged step and the next checkpoint leaves
@@ -114,7 +162,14 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
             tracing = False
             log_fn(f"[train] wrote profiler trace to {trace_dir}")
         rng, sub = jax.random.split(rng)
-        batch = Batch(*jax.tree.map(jnp.asarray, tuple(batch_np)))
+        if multihost:
+            # each process feeds its local slice; the arrays are global,
+            # sharded over 'data' across every host's devices (rng/state are
+            # replicated, so the update is identical everywhere)
+            batch = Batch(*(mh_assemble(x) for x in tuple(batch_np)))
+            sub = mh_assemble(sub, jax.sharding.PartitionSpec())
+        else:
+            batch = Batch(*jax.tree.map(jnp.asarray, tuple(batch_np)))
         state, metrics = step_fn(state, batch, sub)
         seen += 1
         if step % tconfig.log_every == 0 or step + 1 >= tconfig.num_steps:
@@ -123,7 +178,7 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
             log_fn(f"[train] step {step}  loss {float(m['loss']):.4f}  "
                    f"epe {float(m['epe']):.3f}  1px {float(m['1px']):.3f}  "
                    f"gnorm {float(m['grad_norm']):.2f}  {rate:.2f} it/s")
-            if metrics_path:
+            if metrics_path and is_main:
                 rec = {"step": step, "it_per_s": round(rate, 4),
                        "wall_s": round(time.time() - t0, 2)}
                 rec.update({k: float(v) for k, v in m.items()})
@@ -144,14 +199,14 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
                     f"non-finite loss at {nonfinite_streak} consecutive "
                     f"logged steps (last: step {step}); last good checkpoint "
                     f"is in {ckpt_dir or '<none>'}")
-        if ckpt_dir and (step + 1) % tconfig.ckpt_every == 0:
+        if ckpt_dir and is_main and (step + 1) % tconfig.ckpt_every == 0:
             _save_if_finite(Path(ckpt_dir) / f"ckpt_{step + 1}.npz",
                             state, log_fn)
 
     if tracing:
         jax.profiler.stop_trace()
         log_fn(f"[train] wrote profiler trace to {trace_dir}")
-    if ckpt_dir:
+    if ckpt_dir and is_main:
         _save_if_finite(Path(ckpt_dir) / f"ckpt_{int(state.step)}.npz",
                         state, log_fn, final=True)
     return state
@@ -189,12 +244,39 @@ def train_cli(args, config: RAFTConfig) -> int:
         overrides["image_size"] = tuple(args.train_size)
     tconfig = TrainConfig.for_stage(args.dataset, **overrides)
 
+    # multi-host: tconfig.batch_size is the GLOBAL batch; every process
+    # builds the same deterministic sample stream (same seed) and keeps only
+    # its local_batch_slice — byte-identical to the single-process batch
+    # order, which is what makes the multi-process loss-parity smoke test
+    # meaningful.  (Decode cost is replicated across hosts; for IO-bound
+    # runs shard the file list per host instead and skip the slicing.)
+    pcount = jax.process_count()
+    assert tconfig.batch_size % max(pcount, 1) == 0, \
+        (tconfig.batch_size, pcount)
+
+    def _local_slices(global_batches):
+        from ..parallel.distributed import local_batch_slice
+        sl = local_batch_slice(tconfig.batch_size)
+        for b in global_batches:
+            yield tuple(x[sl] for x in b)
+
     mp_loader = None
     if args.data or args.dataset == "synthetic":
         from ..data.datasets import make_training_dataset
         ds = make_training_dataset(args.dataset, args.data, tconfig.image_size)
         print(f"[train] {args.dataset}: {len(ds)} samples")
         workers = getattr(args, "workers", 0)
+        if workers >= 1 and pcount > 1:
+            # MP worker arrival order is scheduling-dependent (mp_loader.py),
+            # so each host would slice a DIFFERENTLY-ordered stream: some
+            # samples trained twice, others never, silently.  Refuse rather
+            # than corrupt; per-host file-list sharding is the IO-scaling
+            # path for multi-host.
+            raise ValueError(
+                "--workers is not supported with multi-host training: the "
+                "worker pool reorders samples per host, breaking the "
+                "identical-global-stream slicing. Drop --workers (decode "
+                "runs in the prefetch thread).")
         if workers >= 1:
             from ..data.mp_loader import MPSampleLoader
             mp_loader = MPSampleLoader(ds, num_workers=workers,
@@ -203,12 +285,14 @@ def train_cli(args, config: RAFTConfig) -> int:
             print(f"[train] {workers} decode/augment worker processes")
         else:
             sample_iter = ds.sample_iter(seed=tconfig.seed)
-        batch_iter = PrefetchLoader(batched(sample_iter, tconfig.batch_size))
+        raw = batched(sample_iter, tconfig.batch_size)
+        batch_iter = PrefetchLoader(_local_slices(raw) if pcount > 1 else raw)
     else:
         print("[train] no --data: running on RANDOM batches (smoke mode; "
               "use --dataset synthetic for data with real ground truth)")
         size = (64, 96)
-        batch_iter = PrefetchLoader(synthetic_batches(tconfig.batch_size, size))
+        raw = synthetic_batches(tconfig.batch_size, size)
+        batch_iter = PrefetchLoader(_local_slices(raw) if pcount > 1 else raw)
 
     ckpt_dir = str(Path(args.out) / tconfig.ckpt_dir)
     try:
