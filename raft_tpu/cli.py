@@ -53,6 +53,12 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--save-flo", action="store_true", help="also write .flo")
     p.add_argument("--show", action="store_true", help="cv2.imshow the result")
     p.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    p.add_argument("--spatial", type=int, default=None, metavar="N",
+                   help="test mode: row-shard the whole model over N devices "
+                        "(sequence-parallel inference: halo convs, psum "
+                        "norms, ring-pass correlation — parallel/spatial."
+                        "make_shard_inference_fn). H must be divisible by "
+                        "8*N*2^(corr_levels-1)")
     p.add_argument("--trace", default=None, metavar="DIR",
                    help="write a jax.profiler trace (XPlane, viewable in "
                         "TensorBoard/Perfetto) of the steady-state run "
@@ -162,7 +168,32 @@ def mode_test(args) -> int:
         im1 = np.repeat(im1, args.batch, axis=0)
         im2 = np.repeat(im2, args.batch, axis=0)
 
-    fn = jax.jit(make_inference_fn(config))
+    if args.spatial and args.spatial > 1:
+        # sequence-parallel path: the whole model runs row-sharded over N
+        # devices (explicit shard_map: halo-exchange convs, psum'd norms,
+        # ring-pass correlation) — the runnable CLI surface of the
+        # long-context story, complementing multi-host -m train
+        from jax.sharding import Mesh
+        from .parallel.spatial import (make_shard_inference_fn,
+                                       required_h_multiple)
+
+        n = args.spatial
+        if len(jax.devices()) < n:
+            print(f"ERROR: --spatial {n} needs {n} devices, have "
+                  f"{len(jax.devices())}")
+            return 2
+        need = required_h_multiple(config, n)
+        h = im1.shape[1]
+        if h % need:
+            print(f"ERROR: --spatial {n} requires H divisible by {need} "
+                  f"(8 * N devices * 2^(corr_levels-1)); got H={h}. "
+                  f"Pick --size accordingly, e.g. H={((h // need) + 1) * need}")
+            return 2
+        mesh = Mesh(np.array(jax.devices()[:n]), ("spatial",))
+        fn = make_shard_inference_fn(config, mesh)
+        print(f"[test] sequence-parallel: rows sharded over {n} devices")
+    else:
+        fn = jax.jit(make_inference_fn(config))
     t0 = time.time()
     flow = np.asarray(fn(params, jnp.asarray(im1), jnp.asarray(im2)))
     t1 = time.time()

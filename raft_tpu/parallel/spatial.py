@@ -24,6 +24,15 @@ from ..ops.corr import (build_pyramid, dense_corr, fmap2_pyramid,
 from .mesh import SPATIAL_AXIS
 
 
+def required_h_multiple(config: RAFTConfig, n_devices: int) -> int:
+    """Smallest multiple the input H must divide into for whole-model
+    row-sharded inference over ``n_devices``: the /8 feature stem times the
+    per-shard pyramid-pooling constraint of the ring lookup (local H/8 slab
+    divisible by 2^(corr_levels-1) — see make_ring_lookup_local).  The single
+    source of truth for callers validating sizes (e.g. the CLI)."""
+    return 8 * n_devices * 2 ** (config.corr_levels - 1)
+
+
 def halo_exchange(x: jax.Array, halo: int, axis_name: str = SPATIAL_AXIS) -> jax.Array:
     """Neighbor-row halo padding of a row-sharded block; the single
     implementation lives in ops.spmd (re-exported here with the spatial-axis

@@ -251,8 +251,10 @@ def train_cli(args, config: RAFTConfig) -> int:
     # meaningful.  (Decode cost is replicated across hosts; for IO-bound
     # runs shard the file list per host instead and skip the slicing.)
     pcount = jax.process_count()
-    assert tconfig.batch_size % max(pcount, 1) == 0, \
-        (tconfig.batch_size, pcount)
+    if pcount > 1 and tconfig.batch_size % pcount != 0:
+        raise ValueError(
+            f"global batch {tconfig.batch_size} must divide evenly across "
+            f"{pcount} processes (each loads batch/processes samples)")
 
     def _local_slices(global_batches):
         from ..parallel.distributed import local_batch_slice

@@ -22,6 +22,35 @@ def test_mode_test_writes_png(tmp_path, capsys):
     assert im.shape == (48, 64, 3)
 
 
+def test_mode_test_spatial_matches_plain(tmp_path, capsys):
+    """--spatial N: whole-model row-sharded inference through the CLI must
+    produce the same flow as the plain single-device run (same seeded random
+    init), and reject sizes violating the divisibility contract with a clear
+    error instead of an XLA crash."""
+    from raft_tpu.utils import read_flo
+
+    rc = cli.main(["-m", "test", "--small", "--iters", "2",
+                   "--size", "128", "64", "--save-flo",
+                   "--out", str(tmp_path / "plain")])
+    assert rc == 0
+    rc = cli.main(["-m", "test", "--small", "--iters", "2",
+                   "--size", "128", "64", "--save-flo", "--spatial", "2",
+                   "--out", str(tmp_path / "sp")])
+    assert rc == 0
+    assert "sequence-parallel: rows sharded over 2 devices" in \
+        capsys.readouterr().out
+    plain = read_flo(tmp_path / "plain" / "raft_flow_raft-small.flo")
+    sp = read_flo(tmp_path / "sp" / "raft_flow_raft-small.flo")
+    np.testing.assert_allclose(sp, plain, atol=2e-2, rtol=1e-3)
+
+    # H=120 violates H % (8*2*2^3) == 0 -> clear validation error, rc 2
+    rc = cli.main(["-m", "test", "--small", "--iters", "2",
+                   "--size", "120", "64", "--spatial", "2",
+                   "--out", str(tmp_path / "bad")])
+    assert rc == 2
+    assert "divisible by 128" in capsys.readouterr().out
+
+
 def test_mode_flops_reports(capsys):
     rc = cli.main(["-m", "flops", "--small", "--iters", "2"])
     assert rc == 0
