@@ -91,6 +91,11 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--workers", type=int, default=0,
                    help="decode/augment worker processes (0 = in-line in the "
                         "prefetch thread); the PrefetchDataZMQ analog")
+    p.add_argument("--accum", type=int, default=None, metavar="K",
+                   help="train mode: split each batch into K sequential "
+                        "micro-batches inside the jitted step (gradient "
+                        "accumulation; K must divide the batch) — fits the "
+                        "official large-batch recipes in one chip's HBM")
     p.add_argument("-o", "--optimizer", default="adamw",
                    choices=["adam", "adamw", "sgd", "sgd_cyclic", "sgd_1cycle"])
     p.add_argument("--lr", type=float, default=None)

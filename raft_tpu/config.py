@@ -113,6 +113,13 @@ class TrainConfig:
 
     num_steps: int = 100_000
     batch_size: int = 6
+    # micro-batch gradient accumulation inside the jitted step: the batch is
+    # split into accum_steps sequential slices (lax.scan), cutting peak
+    # activation memory by that factor while the optimizer sees the averaged
+    # full-batch gradient — the single-chip fit knob for the official
+    # batch 10-12 x (368,496) x many-iteration recipes.  batch_size (and,
+    # under data-parallel, the per-device batch) must divide evenly.
+    accum_steps: int = 1
     image_size: Tuple[int, int] = (368, 496)
     lr: float = 4e-4
     weight_decay: float = 1e-5   # reference RAFT.py:14 (declared, unused there)

@@ -57,6 +57,18 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
         log_fn(f"[train] batch {tconfig.batch_size} not divisible by "
                f"{n_dev} devices; falling back to single-device")
         data_parallel = False
+    if tconfig.accum_steps > 1:
+        # the step splits each DEVICE's batch into accum micro-batches, so
+        # validate here — in global-batch terms — rather than letting the
+        # shard_map trace fail on the per-device slice
+        per_dev = (tconfig.batch_size // n_dev
+                   if (data_parallel and n_dev > 1) else tconfig.batch_size)
+        if per_dev % tconfig.accum_steps:
+            raise ValueError(
+                f"accum_steps {tconfig.accum_steps} must divide the "
+                f"per-device batch {per_dev} (global batch "
+                f"{tconfig.batch_size} over "
+                f"{n_dev if data_parallel and n_dev > 1 else 1} devices)")
     if multihost:
         from jax.sharding import PartitionSpec
         from ..parallel.data_parallel import make_pjit_train_step
@@ -240,6 +252,8 @@ def train_cli(args, config: RAFTConfig) -> int:
         overrides["lr"] = args.lr
     if args.batch is not None:
         overrides["batch_size"] = args.batch
+    if getattr(args, "accum", None) is not None:
+        overrides["accum_steps"] = args.accum
     if getattr(args, "train_size", None):
         overrides["image_size"] = tuple(args.train_size)
     tconfig = TrainConfig.for_stage(args.dataset, **overrides)

@@ -30,11 +30,22 @@ class Batch(NamedTuple):
 def make_train_step(config: RAFTConfig, tconfig: TrainConfig,
                     tx: optax.GradientTransformation,
                     axis_name: Optional[str] = None):
-    """Returns step(state, batch, rng) -> (new_state, metrics)."""
+    """Returns step(state, batch, rng) -> (new_state, metrics).
 
-    def train_step(state: TrainState, batch: Batch, rng: jax.Array):
+    With ``tconfig.accum_steps > 1`` the batch is split into that many
+    micro-batches processed sequentially inside the jitted step
+    (``lax.scan``): peak activation memory drops by the accumulation factor
+    while the optimizer still sees the averaged full-batch gradient — how the
+    official recipe's batch 10-12 at (368,496) x many GRU iterations fits a
+    single chip's HBM.  Micro-batch losses are averaged (exact full-batch
+    equality when valid-pixel counts match across micro-batches, the
+    standard accumulation semantics); BN statistics update sequentially
+    through the micro-batches.
+    """
+
+    def grad_fn(trainable, bn_state, batch: Batch, rng: jax.Array):
         def loss_fn(trainable):
-            params = merge_bn_state(trainable, state.bn_state)
+            params = merge_bn_state(trainable, bn_state)
             out, new_params = raft_forward(
                 params, batch.image1, batch.image2, config, train=True,
                 axis_name=axis_name, rng=rng)
@@ -44,7 +55,34 @@ def make_train_step(config: RAFTConfig, tconfig: TrainConfig,
             _, new_bn = split_bn_state(new_params)
             return loss, (new_bn, metrics)
 
-        grads, (new_bn, metrics) = jax.grad(loss_fn, has_aux=True)(state.params)
+        return jax.grad(loss_fn, has_aux=True)(trainable)
+
+    accum = tconfig.accum_steps
+
+    def train_step(state: TrainState, batch: Batch, rng: jax.Array):
+        if accum <= 1:
+            grads, (new_bn, metrics) = grad_fn(state.params, state.bn_state,
+                                               batch, rng)
+        else:
+            B = batch.image1.shape[0]
+            if B % accum:
+                raise ValueError(f"batch {B} not divisible by "
+                                 f"accum_steps {accum}")
+            micro = jax.tree.map(
+                lambda x: x.reshape(accum, B // accum, *x.shape[1:]), batch)
+            rngs = jax.random.split(rng, accum)
+
+            def micro_step(carry, xs):
+                gacc, bn = carry
+                mb, r = xs
+                g, (bn_next, m) = grad_fn(state.params, bn, mb, r)
+                return (jax.tree.map(jnp.add, gacc, g), bn_next), m
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (gsum, new_bn), mstack = jax.lax.scan(
+                micro_step, (zeros, state.bn_state), (micro, rngs))
+            grads = jax.tree.map(lambda g: g / accum, gsum)
+            metrics = jax.tree.map(lambda m: m.mean(0), mstack)
         if axis_name is not None:
             grads = jax.lax.pmean(grads, axis_name)
             metrics = jax.lax.pmean(metrics, axis_name)
