@@ -68,8 +68,16 @@ def make_train_step(config: RAFTConfig, tconfig: TrainConfig,
             if B % accum:
                 raise ValueError(f"batch {B} not divisible by "
                                  f"accum_steps {accum}")
+            # stride-major split: micro k takes samples k, k+accum, ... so
+            # under a batch-sharded pjit each device keeps 1/accum of ITS
+            # OWN contiguous samples per micro-batch (per-device batch is
+            # validated divisible by accum) — every micro step runs on all
+            # devices with no cross-device resharding, unlike a contiguous
+            # (accum, B/accum) split whose first micro would live on the
+            # first 1/accum of the devices only
             micro = jax.tree.map(
-                lambda x: x.reshape(accum, B // accum, *x.shape[1:]), batch)
+                lambda x: x.reshape(B // accum, accum,
+                                    *x.shape[1:]).swapaxes(0, 1), batch)
             rngs = jax.random.split(rng, accum)
 
             def micro_step(carry, xs):
