@@ -32,7 +32,7 @@ from .step import make_eval_step
 def evaluate_dataset(params, config: RAFTConfig, dataset,
                      iters: Optional[int] = None, max_samples: Optional[int] = None,
                      pad_mode: str = "sintel", bucket: int = 8,
-                     weighting: str = "sample",
+                     weighting: str = "sample", batch_size: int = 1,
                      verbose: bool = True) -> Dict[str, float]:
     """dataset yields (im1, im2, flow_gt, valid) numpy samples (augmentor=None).
 
@@ -49,8 +49,15 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
     pixels across the whole dataset before dividing — the official KITTI
     convention for Fl-all/EPE, where images with more valid ground-truth
     pixels weigh more; with per-image-variable valid counts the two differ.
+
+    ``batch_size``: samples per device call, grouped by padded shape (the
+    metrics are per-sample either way, so the numbers are identical —
+    batching only amortizes the per-call overhead, which dominates at small
+    eval resolutions on TPU).  A shape group's remainder runs at its natural
+    size: at most one extra compile per distinct padded shape.
     """
     assert bucket % 8 == 0 and bucket > 0, bucket
+    assert batch_size >= 1, batch_size
     if weighting not in ("sample", "pixel"):
         raise ValueError(f"weighting must be 'sample' or 'pixel', "
                          f"got {weighting!r}")
@@ -60,23 +67,42 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
     shapes_seen = set()
     t0 = time.time()
     n = len(dataset) if max_samples is None else min(max_samples, len(dataset))
+
+    def flush(group):
+        nonlocal count
+        # record the executable's ACTUAL input shape (batch included): with
+        # batching, a shape group costs one compile per distinct flush size
+        # (full batches + at most one remainder)
+        shapes_seen.add((len(group),) + group[0][0].shape[1:])
+        flows = np.asarray(eval_fn(
+            params, jnp.asarray(np.concatenate([g[0] for g in group])),
+            jnp.asarray(np.concatenate([g[1] for g in group]))))
+        for (im1p, _, pads, flow_gt, valid), flow in zip(group, flows):
+            fl = unpad(flow[None], pads)[0]
+            m = jax.device_get(epe_metrics(
+                jnp.asarray(fl), jnp.asarray(flow_gt), jnp.asarray(valid),
+                reduce="sum" if weighting == "pixel" else "mean"))
+            for k, v in m.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+            count += 1
+            if verbose and count % 50 == 0:
+                running = (sums["epe"] / max(sums.get("valid_px", 1.0), 1.0)
+                           if weighting == "pixel" else sums["epe"] / count)
+                print(f"  eval {count}/{n}  epe so far {running:.3f}")
+
+    groups: Dict[tuple, list] = {}
     for idx in range(n):
         im1, im2, flow_gt, valid = dataset[idx]
         im1p, pads = pad_to_multiple(im1[None], bucket, pad_mode)
         im2p, _ = pad_to_multiple(im2[None], bucket, pad_mode)
-        shapes_seen.add(im1p.shape)
-        flow = np.asarray(eval_fn(params, jnp.asarray(im1p), jnp.asarray(im2p)))
-        flow = unpad(flow, pads)[0]
-        m = jax.device_get(epe_metrics(
-            jnp.asarray(flow), jnp.asarray(flow_gt), jnp.asarray(valid),
-            reduce="sum" if weighting == "pixel" else "mean"))
-        for k, v in m.items():
-            sums[k] = sums.get(k, 0.0) + float(v)
-        count += 1
-        if verbose and (idx + 1) % 50 == 0:
-            running = (sums["epe"] / max(sums.get("valid_px", 1.0), 1.0)
-                       if weighting == "pixel" else sums["epe"] / count)
-            print(f"  eval {idx + 1}/{n}  epe so far {running:.3f}")
+        group = groups.setdefault(im1p.shape, [])
+        group.append((im1p, im2p, pads, flow_gt, valid))
+        if len(group) == batch_size:
+            flush(group)
+            group.clear()
+    for group in groups.values():   # shape-group remainders
+        if group:
+            flush(group)
     if weighting == "pixel":
         denom = max(sums.pop("valid_px", 0.0), 1.0)
         out = {k: v / denom for k, v in sums.items()}
@@ -84,8 +110,10 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
         out = {k: v / max(count, 1) for k, v in sums.items()}
     out["samples"] = count
     out["seconds"] = time.time() - t0
-    # one XLA compile per distinct padded shape — the observable the bucketing
-    # exists to bound (and what tests assert on)
+    # one XLA compile per distinct EXECUTABLE input shape, batch included
+    # (per padded shape: its full-batch size plus at most one remainder
+    # size) — the observable the bucketing exists to bound (and what tests
+    # assert on)
     out["compiled_shapes"] = len(shapes_seen)
     return out
 
@@ -97,6 +125,9 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
         # validate before the (slow) checkpoint load / dataset scan
         print(f"ERROR: --bucket must be a positive multiple of 8, "
               f"got {args.bucket}")
+        return 2
+    if getattr(args, "eval_batch", None) is not None and args.eval_batch < 1:
+        print(f"ERROR: --eval-batch must be >= 1, got {args.eval_batch}")
         return 2
     params = load_params(args, config)
     bucket = 8
@@ -135,7 +166,8 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
         "pixel" if args.dataset == "kitti" else "sample")
     metrics = evaluate_dataset(params, config, ds, iters=args.iters,
                                pad_mode=pad_mode, bucket=bucket,
-                               weighting=weighting)
+                               weighting=weighting,
+                               batch_size=getattr(args, "eval_batch", None) or 1)
     name = f"{args.dataset} ({'small' if args.small else 'full'})"
     print(f"[val] {name}: " + "  ".join(
         f"{k}={v:.4f}" for k, v in metrics.items()))

@@ -429,6 +429,44 @@ def test_eval_resolution_bucketing():
     assert out8["compiled_shapes"] >= 3, out8["compiled_shapes"]
 
 
+def test_eval_batched_matches_unbatched():
+    """batch_size groups samples per device call but metrics stay per-sample:
+    the numbers must be IDENTICAL to the one-at-a-time loop, both when all
+    five samples collapse into one shape group (bucket=16: flushes 2+2+1)
+    and when they fragment across several groups that each hold a remainder
+    (bucket=8: 4 distinct padded shapes, batch 2)."""
+    from raft_tpu.training.evaluate import evaluate_dataset
+
+    config = RAFTConfig.small_model(iters=2)
+    params = init_raft(jax.random.PRNGKey(0), config)
+    ds = _MixedResolutionDataset()
+
+    one = evaluate_dataset(params, config, ds, bucket=16, verbose=False)
+    batched = evaluate_dataset(params, config, ds, bucket=16, batch_size=2,
+                               verbose=False)
+    assert batched["samples"] == one["samples"] == len(ds)
+    # full-batch (2,H,W) executable + the size-1 remainder = 2 compiles
+    assert batched["compiled_shapes"] == 2, batched["compiled_shapes"]
+    for k in ("epe", "1px", "fl_all"):
+        np.testing.assert_allclose(batched[k], one[k], rtol=1e-5, atol=1e-6)
+
+    # multi-group remainders: bucket=8 fragments the five sizes into >= 3
+    # padded shapes, every group smaller than the batch -> all flushed by
+    # the trailing remainder loop
+    one8 = evaluate_dataset(params, config, ds, bucket=8, verbose=False)
+    bat8 = evaluate_dataset(params, config, ds, bucket=8, batch_size=2,
+                            verbose=False)
+    for k in ("epe", "1px", "fl_all"):
+        np.testing.assert_allclose(bat8[k], one8[k], rtol=1e-5, atol=1e-6)
+
+    # pixel weighting composes with batching too
+    one_p = evaluate_dataset(params, config, ds, bucket=16,
+                             weighting="pixel", verbose=False)
+    bat_p = evaluate_dataset(params, config, ds, bucket=16, batch_size=3,
+                             weighting="pixel", verbose=False)
+    np.testing.assert_allclose(bat_p["epe"], one_p["epe"], rtol=1e-5)
+
+
 class _UnequalValidDataset:
     """Two same-size samples with very different valid-pixel counts — the
     case where per-sample and pixel-pooled aggregation must diverge."""
