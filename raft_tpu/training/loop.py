@@ -29,13 +29,29 @@ from .step import Batch, make_train_step
 def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
           ckpt_dir: Optional[str] = None, resume: bool = True,
           data_parallel: bool = True, log_fn=print,
-          trace_dir: Optional[str] = None) -> TrainState:
+          trace_dir: Optional[str] = None,
+          init_params: Optional[dict] = None) -> TrainState:
     """Run the training loop over ``batch_iter`` yielding numpy
-    (im1, im2, flow, valid) batches; returns the final state."""
+    (im1, im2, flow, valid) batches; returns the final state.
+
+    ``init_params``: warm-start weights (full merged pytree, e.g. from
+    ``convert.load_checkpoint_auto``) instead of random init — how the
+    official curriculum chains stages (chairs -> things -> sintel/kitti).
+    The optimizer starts fresh at step 0; a resumable checkpoint in
+    ``ckpt_dir`` still takes precedence (continuation beats warm start).
+    """
     tx = make_optimizer(tconfig)
-    key = jax.random.PRNGKey(tconfig.seed)
-    params = init_raft(key, config)
-    state = TrainState.create(params, tx)
+    if init_params is None:
+        init_params = init_raft(jax.random.PRNGKey(tconfig.seed), config)
+    else:
+        # fail with a clear message on a checkpoint/config mismatch (e.g.
+        # full-model weights with --small) instead of a cryptic trace error
+        # in the first jitted step
+        from ..convert import assert_tree_shapes_match
+        assert_tree_shapes_match(
+            init_params, init_raft(jax.random.PRNGKey(0), config))
+        init_params = jax.tree.map(jnp.asarray, init_params)
+    state = TrainState.create(init_params, tx)
 
     # multi-host: every process runs this same loop; jax.devices() spans all
     # hosts once parallel.distributed.initialize has connected them (the
@@ -258,6 +274,15 @@ def train_cli(args, config: RAFTConfig) -> int:
         overrides["image_size"] = tuple(args.train_size)
     tconfig = TrainConfig.for_stage(args.dataset, **overrides)
 
+    # stage warm start (official curriculum: each stage --load's the previous
+    # stage's weights); the universal loader digests torch .pth / reference
+    # .npz / native training checkpoints alike.  Load BEFORE constructing
+    # the data loader so a bad --load cannot leak worker processes.
+    init_params = None
+    if getattr(args, "load", None):
+        from ..cli import _load_params
+        init_params = _load_params(args, config)
+
     # multi-host: tconfig.batch_size is the GLOBAL batch; every process
     # builds the same deterministic sample stream (same seed) and keeps only
     # its local_batch_slice — byte-identical to the single-process batch
@@ -313,7 +338,8 @@ def train_cli(args, config: RAFTConfig) -> int:
     ckpt_dir = str(Path(args.out) / tconfig.ckpt_dir)
     try:
         train(config, tconfig, batch_iter, ckpt_dir=ckpt_dir,
-              trace_dir=getattr(args, "trace", None))
+              trace_dir=getattr(args, "trace", None),
+              init_params=init_params)
     finally:
         if mp_loader is not None:
             # reap worker processes + feeder even when train() raises (e.g.

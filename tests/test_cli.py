@@ -22,6 +22,38 @@ def test_mode_test_writes_png(tmp_path, capsys):
     assert im.shape == (48, 64, 3)
 
 
+def test_train_warm_start_from_checkpoint(tmp_path, capsys):
+    """-m train --load warm-starts from existing weights (the official
+    curriculum chains stages this way: things --load's chairs, etc.).
+    With lr=0 the warm-started run must END with exactly the loaded
+    weights — proof the init came from the checkpoint, not random."""
+    from raft_tpu.convert import load_checkpoint_auto
+    import jax
+
+    rc = cli.main(["--demo-train", "--num-steps", "2", "--iters", "2",
+                   "--batch", "2", "--train-size", "32", "48",
+                   "--out", str(tmp_path / "a")])
+    assert rc == 0
+    src = tmp_path / "a" / "checkpoints" / "ckpt_2.npz"
+
+    rc = cli.main(["-m", "train", "--dataset", "synthetic", "--small",
+                   "--iters", "2", "--num-steps", "1", "--batch", "2",
+                   "--train-size", "32", "48", "--optimizer", "sgd",
+                   "--lr", "0", "--load", str(src),
+                   "--out", str(tmp_path / "b")])
+    assert rc == 0
+    assert f"loaded checkpoint from {src}" in capsys.readouterr().out
+
+    want = load_checkpoint_auto(src)
+    got = load_checkpoint_auto(tmp_path / "b" / "checkpoints" / "ckpt_1.npz")
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(want)[0],
+            jax.tree_util.tree_flatten_with_path(got)[0], strict=True):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str(pa))
+
+
 def test_mode_test_spatial_matches_plain(tmp_path, capsys):
     """--spatial N: whole-model row-sharded inference through the CLI must
     produce the same flow as the plain single-device run (same seeded random
