@@ -73,6 +73,11 @@ def _build_parser() -> argparse.ArgumentParser:
                         "per-image means (Sintel protocol), 'pixel' pools "
                         "valid pixels across images (official KITTI "
                         "convention; default for --dataset kitti)")
+    p.add_argument("--dump-flow", default=None, metavar="DIR",
+                   help="val mode: also write every prediction to DIR, in "
+                        "dataset order — 16-bit flow PNG encoding for "
+                        "--dataset kitti, .flo otherwise (rename per the "
+                        "KITTI devkit scheme for a server submission)")
     p.add_argument("--eval-batch", type=int, default=None, metavar="N",
                    help="val mode: samples per device call, grouped by "
                         "padded shape (identical metrics; amortizes per-call "

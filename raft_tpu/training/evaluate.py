@@ -33,6 +33,7 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
                      iters: Optional[int] = None, max_samples: Optional[int] = None,
                      pad_mode: str = "sintel", bucket: int = 8,
                      weighting: str = "sample", batch_size: int = 1,
+                     dump_dir: Optional[str] = None,
                      verbose: bool = True) -> Dict[str, float]:
     """dataset yields (im1, im2, flow_gt, valid) numpy samples (augmentor=None).
 
@@ -55,6 +56,14 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
     batching only amortizes the per-call overhead, which dominates at small
     eval resolutions on TPU).  A shape group's remainder runs at its natural
     size: at most one extra compile per distinct padded shape.
+
+    ``dump_dir``: also write each unpadded prediction, named
+    ``frame_<idx:06d>`` in dataset order — KITTI 16-bit flow PNG encoding
+    for ``pad_mode="kitti"``, ``.flo`` otherwise.  This is the prediction-
+    export half of the official repo's create_*_submission tools; an actual
+    KITTI server upload additionally needs the devkit's ``<frame>_10.png``
+    naming and the testing split (this harness evaluates the training
+    split, which has ground truth).
     """
     assert bucket % 8 == 0 and bucket > 0, bucket
     assert batch_size >= 1, batch_size
@@ -68,6 +77,11 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
     t0 = time.time()
     n = len(dataset) if max_samples is None else min(max_samples, len(dataset))
 
+    if dump_dir is not None:
+        from pathlib import Path
+        from ..utils.flow_io import write_flo, write_kitti_flow
+        Path(dump_dir).mkdir(parents=True, exist_ok=True)
+
     def flush(group):
         nonlocal count
         # record the executable's ACTUAL input shape (batch included): with
@@ -77,8 +91,14 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
         flows = np.asarray(eval_fn(
             params, jnp.asarray(np.concatenate([g[0] for g in group])),
             jnp.asarray(np.concatenate([g[1] for g in group]))))
-        for (im1p, _, pads, flow_gt, valid), flow in zip(group, flows):
+        for (im1p, _, pads, flow_gt, valid, idx), flow in zip(group, flows):
             fl = unpad(flow[None], pads)[0]
+            if dump_dir is not None:
+                if pad_mode == "kitti":     # the KITTI server's 16-bit PNG
+                    write_kitti_flow(fl, Path(dump_dir) /
+                                     f"frame_{idx:06d}.png")
+                else:
+                    write_flo(fl, Path(dump_dir) / f"frame_{idx:06d}.flo")
             m = jax.device_get(epe_metrics(
                 jnp.asarray(fl), jnp.asarray(flow_gt), jnp.asarray(valid),
                 reduce="sum" if weighting == "pixel" else "mean"))
@@ -96,7 +116,7 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
         im1p, pads = pad_to_multiple(im1[None], bucket, pad_mode)
         im2p, _ = pad_to_multiple(im2[None], bucket, pad_mode)
         group = groups.setdefault(im1p.shape, [])
-        group.append((im1p, im2p, pads, flow_gt, valid))
+        group.append((im1p, im2p, pads, flow_gt, valid, idx))
         if len(group) == batch_size:
             flush(group)
             group.clear()
@@ -167,7 +187,8 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
     metrics = evaluate_dataset(params, config, ds, iters=args.iters,
                                pad_mode=pad_mode, bucket=bucket,
                                weighting=weighting,
-                               batch_size=getattr(args, "eval_batch", None) or 1)
+                               batch_size=getattr(args, "eval_batch", None) or 1,
+                               dump_dir=getattr(args, "dump_flow", None))
     name = f"{args.dataset} ({'small' if args.small else 'full'})"
     print(f"[val] {name}: " + "  ".join(
         f"{k}={v:.4f}" for k, v in metrics.items()))

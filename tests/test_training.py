@@ -467,6 +467,42 @@ def test_eval_batched_matches_unbatched():
     np.testing.assert_allclose(bat_p["epe"], one_p["epe"], rtol=1e-5)
 
 
+def test_eval_dump_flow_roundtrip(tmp_path):
+    """--dump-flow writes every unpadded prediction in dataset order with
+    stable names even under batching, and the .flo round-trips to the exact
+    flow the metrics were computed on (per-sample original sizes)."""
+    from raft_tpu.training.evaluate import evaluate_dataset
+    from raft_tpu.utils import read_flo
+
+    config = RAFTConfig.small_model(iters=2)
+    params = init_raft(jax.random.PRNGKey(0), config)
+    ds = _MixedResolutionDataset()
+    out = evaluate_dataset(params, config, ds, bucket=16, batch_size=2,
+                           dump_dir=str(tmp_path), verbose=False)
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert files == [f"frame_{i:06d}.flo" for i in range(len(ds))]
+    for i in range(len(ds)):
+        fl = read_flo(tmp_path / f"frame_{i:06d}.flo")
+        assert fl.shape[:2] == ds.SIZES[i], (fl.shape, ds.SIZES[i])
+        assert np.isfinite(fl).all()
+    assert out["samples"] == len(ds)
+
+    # value-level oracle for one sample: the dumped file must hold THIS
+    # model's prediction for THIS input (not the GT, not a stale buffer)
+    from raft_tpu.data.pipeline import pad_to_multiple, unpad
+    from raft_tpu.training.step import make_eval_step
+    im1, im2, _, _ = ds[3]
+    im1p, pads = pad_to_multiple(im1[None], 16, "sintel")
+    im2p, _ = pad_to_multiple(im2[None], 16, "sintel")
+    want = unpad(np.asarray(jax.jit(make_eval_step(config, iters=2))(
+        params, jnp.asarray(im1p), jnp.asarray(im2p))), pads)[0]
+    # tolerance: the dump came from a batch-2 executable, the oracle from a
+    # batch-1 one — XLA float association differs at the 1e-3 level, while a
+    # wrong-array regression (GT or another sample) differs by whole pixels
+    np.testing.assert_allclose(read_flo(tmp_path / "frame_000003.flo"),
+                               want, atol=5e-3, rtol=1e-3)
+
+
 class _UnequalValidDataset:
     """Two same-size samples with very different valid-pixel counts — the
     case where per-sample and pixel-pooled aggregation must diverge."""
