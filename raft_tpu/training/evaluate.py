@@ -81,6 +81,14 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
         from pathlib import Path
         from ..utils.flow_io import write_flo, write_kitti_flow
         Path(dump_dir).mkdir(parents=True, exist_ok=True)
+        stale = sum(1 for _ in Path(dump_dir).iterdir())
+        if stale and verbose:
+            # this run only overwrites the indices it visits — a shorter or
+            # reordered run would leave a previous checkpoint's predictions
+            # interleaved with no way to tell them apart
+            print(f"  WARNING: --dump-flow dir {dump_dir} already holds "
+                  f"{stale} file(s); stale predictions from a previous run "
+                  f"will remain unless overwritten")
 
     def flush(group):
         nonlocal count

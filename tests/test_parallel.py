@@ -87,6 +87,32 @@ def test_dp_train_step_donate_opt_out():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+def test_dp_train_step_composes_with_accumulation():
+    """accum_steps inside the DP shard_map splits each DEVICE's slice: the
+    update must match the plain DP step (equal valid counts, SGD)."""
+    config = RAFTConfig.small_model(iters=2)
+    base = dict(num_steps=10, lr=1e-4, schedule="constant", optimizer="sgd")
+    tconfig = TrainConfig(**base)
+    t_acc = TrainConfig(accum_steps=2, **base)
+    tx = make_optimizer(tconfig)
+    state = TrainState.create(init_raft(jax.random.PRNGKey(0), config), tx)
+    batch = _batch(B=16)                  # 2 per device on the 8-dev mesh
+    rng = jax.random.PRNGKey(1)
+    mesh = make_mesh()
+    sharded = shard_batch(mesh, batch)
+
+    s_plain, m_plain = make_dp_train_step(config, tconfig, tx, mesh)(
+        jax.tree.map(jnp.copy, state), sharded, rng)
+    s_acc, m_acc = make_dp_train_step(config, t_acc, tx, mesh)(
+        jax.tree.map(jnp.copy, state), sharded, rng)
+    np.testing.assert_allclose(float(m_acc["loss"]), float(m_plain["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_acc.params),
+                    jax.tree.leaves(s_plain.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-4)
+
+
 def test_dp_eval_fn():
     config = RAFTConfig.small_model(iters=2)
     params = init_raft(jax.random.PRNGKey(0), config)

@@ -32,6 +32,9 @@ def main() -> int:
                    choices=["default", "highest"],
                    help="override the candidate's corr precision (default: "
                         "whatever the candidate name means in bench.py)")
+    p.add_argument("--accum", type=int, default=1,
+                   help="gradient-accumulation micro-steps (measures the "
+                        "memory-for-time trade of TrainConfig.accum_steps)")
     p.add_argument("--quick", action="store_true",
                    help="tiny shapes for CI smoke (64x96, batch 2, 3 iters)")
     p.add_argument("--cpu", action="store_true")
@@ -65,7 +68,7 @@ def main() -> int:
     if args.precision is not None:
         config = dataclasses.replace(config, corr_precision=args.precision)
     tconfig = TrainConfig(num_steps=1000, batch_size=args.batch,
-                          image_size=(H, W))
+                          image_size=(H, W), accum_steps=args.accum)
     tx = make_optimizer(tconfig)
     state = TrainState.create(init_raft(jax.random.PRNGKey(0), config), tx)
     step = jax.jit(make_train_step(config, tconfig, tx), donate_argnums=0)
@@ -90,11 +93,13 @@ def main() -> int:
 
     print(json.dumps({
         "metric": f"raft-things train-step throughput @ {args.iters} iters, "
-                  f"{args.batch}x{H}x{W} ({impl}, {config.corr_precision})",
+                  f"{args.batch}x{H}x{W} ({impl}, {config.corr_precision}"
+                  + (f", accum {args.accum}" if args.accum > 1 else "") + ")",
         "device": dev.device_kind,
         "value": round(args.batch / dt, 4),
         "unit": "pairs/sec/chip",
         "ms_per_step": round(dt * 1e3, 3),
+        "accum_steps": args.accum,
     }))
     return 0
 
