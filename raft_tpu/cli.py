@@ -73,6 +73,9 @@ def _build_parser() -> argparse.ArgumentParser:
                         "per-image means (Sintel protocol), 'pixel' pools "
                         "valid pixels across images (official KITTI "
                         "convention; default for --dataset kitti)")
+    p.add_argument("--max-samples", type=int, default=None, metavar="N",
+                   help="val mode: evaluate only the first N samples "
+                        "(quick spot checks on big datasets)")
     p.add_argument("--dump-flow", default=None, metavar="DIR",
                    help="val mode: also write every prediction to DIR, in "
                         "dataset order — 16-bit flow PNG encoding for "

@@ -157,6 +157,10 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
     if getattr(args, "eval_batch", None) is not None and args.eval_batch < 1:
         print(f"ERROR: --eval-batch must be >= 1, got {args.eval_batch}")
         return 2
+    if getattr(args, "max_samples", None) is not None and args.max_samples < 1:
+        # a zero/negative cap would 'succeed' with samples=0 — fail instead
+        print(f"ERROR: --max-samples must be >= 1, got {args.max_samples}")
+        return 2
     params = load_params(args, config)
     bucket = 8
     if args.dataset == "synthetic":
@@ -196,7 +200,8 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
                                pad_mode=pad_mode, bucket=bucket,
                                weighting=weighting,
                                batch_size=getattr(args, "eval_batch", None) or 1,
-                               dump_dir=getattr(args, "dump_flow", None))
+                               dump_dir=getattr(args, "dump_flow", None),
+                               max_samples=getattr(args, "max_samples", None))
     name = f"{args.dataset} ({'small' if args.small else 'full'})"
     print(f"[val] {name}: " + "  ".join(
         f"{k}={v:.4f}" for k, v in metrics.items()))

@@ -150,6 +150,24 @@ def test_two_process_distributed_smoke(tmp_path):
         assert f"OK {pid}" in out, out
 
 
+def test_train_cli_refuses_workers_under_multihost(monkeypatch, tmp_path):
+    """--workers with multiple processes would let each host's worker pool
+    reorder samples independently, silently corrupting the identical-stream
+    slicing — train_cli must refuse BEFORE spawning anything."""
+    import argparse
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.training import loop
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    args = argparse.Namespace(
+        dataset="synthetic", data=None, workers=2, optimizer="adamw",
+        num_steps=2, lr=None, batch=4, accum=None, train_size=(32, 48),
+        load=None, out=str(tmp_path), trace=None)
+    with pytest.raises(ValueError, match="--workers is not supported"):
+        loop.train_cli(args, RAFTConfig.small_model(iters=2))
+
+
 def _read_metrics(path):
     import json
     recs = [json.loads(ln) for ln in path.read_text().splitlines()

@@ -30,6 +30,9 @@ def main() -> int:
     ap.add_argument("--ckpt", required=True)
     ap.add_argument("--out", default="artifacts/qualitative_synthetic.png")
     ap.add_argument("--samples", type=int, default=3)
+    ap.add_argument("--full", action="store_true",
+                    help="raft-things/full checkpoint (default: raft-small, "
+                         "the --demo-train variant)")
     ap.add_argument("--iters", type=int, default=8)
     ap.add_argument("--size", type=int, nargs=2, default=(96, 128))
     ap.add_argument("--cpu", action="store_true")
@@ -47,13 +50,25 @@ def main() -> int:
     import jax.numpy as jnp
 
     from raft_tpu.config import RAFTConfig
-    from raft_tpu.convert import load_checkpoint_auto
+    from raft_tpu.convert import assert_tree_shapes_match, load_checkpoint_auto
     from raft_tpu.data.synthetic import SyntheticFlowDataset
+    from raft_tpu.models import init_raft
     from raft_tpu.models.raft import make_inference_fn
     from raft_tpu.utils import flow_to_color
 
-    config = RAFTConfig.small_model(iters=args.iters)
-    params = jax.tree.map(jnp.asarray, load_checkpoint_auto(args.ckpt))
+    config = (RAFTConfig.full if args.full
+              else RAFTConfig.small_model)(iters=args.iters)
+    params = load_checkpoint_auto(args.ckpt)
+    try:
+        assert_tree_shapes_match(params,
+                                 init_raft(jax.random.PRNGKey(0), config))
+    except ValueError as e:
+        variant = "full" if args.full else "small"
+        hint = "drop --full" if args.full else "pass --full"
+        print(f"ERROR: checkpoint does not fit the {variant} model ({e}); "
+              f"{hint}?")
+        return 2
+    params = jax.tree.map(jnp.asarray, params)
     fn = jax.jit(make_inference_fn(config))
 
     # the held-out split: seed 9001, exactly what `-m val --dataset synthetic`
