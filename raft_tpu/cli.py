@@ -117,6 +117,13 @@ def _build_parser() -> argparse.ArgumentParser:
     #       --num-processes 4 --process-id $WORKER_ID ...
     # (env fallbacks RAFT_TPU_COORDINATOR / RAFT_TPU_NUM_PROCESSES /
     # RAFT_TPU_PROCESS_ID let launchers avoid per-host argv edits)
+    p.add_argument("--shard-data", action="store_true",
+                   help="multi-host train: each process loads only its own "
+                        "1/N shard of the dataset (decode cost scales out; "
+                        "streams decorrelate via per-host seeds; --workers "
+                        "allowed). Default: every host builds the identical "
+                        "global stream and keeps its slice (deterministic, "
+                        "but decode cost replicates)")
     p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
                    help="multi-host train: coordinator address for "
                         "jax.distributed.initialize")

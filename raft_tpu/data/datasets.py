@@ -110,6 +110,36 @@ class FlowDataset:
             epoch += 1
 
 
+class ShardedDataset:
+    """Disjoint per-process view of a dataset: samples ``pid, pid+pcount,
+    ...`` — the multi-host IO-scaling path, where each host decodes ONLY its
+    own shard (per-host augmentation seeds decorrelate the streams).  The
+    alternative to the trainer's default identical-global-stream slicing,
+    which replicates decode cost on every host."""
+
+    def __init__(self, ds, pid: int, pcount: int):
+        assert 0 <= pid < pcount, (pid, pcount)
+        if len(ds) <= pid:
+            # an empty shard would make sample_iter spin forever yielding
+            # nothing — this host never reaches its first collective and the
+            # whole multi-host job deadlocks silently.  Fail loudly instead.
+            raise ValueError(
+                f"dataset of {len(ds)} samples cannot shard across "
+                f"{pcount} processes: shard {pid} would be empty")
+        self.ds, self.pid, self.pcount = ds, pid, pcount
+        # augmentor passthrough so pipeline introspection keeps working
+        self.augmentor = getattr(ds, "augmentor", None)
+
+    def __len__(self) -> int:
+        return (len(self.ds) - self.pid + self.pcount - 1) // self.pcount
+
+    def __getitem__(self, idx):
+        return self.ds[idx * self.pcount + self.pid]
+
+    # same shuffle/epoch semantics as FlowDataset, over the shard view
+    sample_iter = FlowDataset.sample_iter
+
+
 class MpiSintel(FlowDataset):
     """root/{training,test}/{clean,final}/<scene>/frame_XXXX.png +
     root/training/flow/<scene>/frame_XXXX.flo"""

@@ -287,8 +287,8 @@ def train_cli(args, config: RAFTConfig) -> int:
     # builds the same deterministic sample stream (same seed) and keeps only
     # its local_batch_slice — byte-identical to the single-process batch
     # order, which is what makes the multi-process loss-parity smoke test
-    # meaningful.  (Decode cost is replicated across hosts; for IO-bound
-    # runs shard the file list per host instead and skip the slicing.)
+    # meaningful.  (Decode cost is replicated across hosts; --shard-data is
+    # the IO-scaling alternative — each host decodes only its own 1/N.)
     pcount = jax.process_count()
     if pcount > 1 and tconfig.batch_size % pcount != 0:
         raise ValueError(
@@ -301,33 +301,47 @@ def train_cli(args, config: RAFTConfig) -> int:
         for b in global_batches:
             yield tuple(x[sl] for x in b)
 
+    shard_data = pcount > 1 and getattr(args, "shard_data", False)
     mp_loader = None
     if args.data or args.dataset == "synthetic":
         from ..data.datasets import make_training_dataset
         ds = make_training_dataset(args.dataset, args.data, tconfig.image_size)
         print(f"[train] {args.dataset}: {len(ds)} samples")
         workers = getattr(args, "workers", 0)
-        if workers >= 1 and pcount > 1:
+        seed = tconfig.seed
+        local_batch = tconfig.batch_size
+        if shard_data:
+            # IO-scaling path: this process decodes only its own 1/pcount
+            # shard and fills its local batch from it directly; per-host
+            # seeds decorrelate the augmentation streams.  Worker pools are
+            # fine here — sample order only affects this host's shard.
+            from ..data.datasets import ShardedDataset
+            pid = jax.process_index()
+            ds = ShardedDataset(ds, pid, pcount)
+            seed = tconfig.seed + 1000003 * pid
+            local_batch = tconfig.batch_size // pcount
+            print(f"[train] data shard {pid}/{pcount}: {len(ds)} samples")
+        elif workers >= 1 and pcount > 1:
             # MP worker arrival order is scheduling-dependent (mp_loader.py),
             # so each host would slice a DIFFERENTLY-ordered stream: some
             # samples trained twice, others never, silently.  Refuse rather
-            # than corrupt; per-host file-list sharding is the IO-scaling
-            # path for multi-host.
+            # than corrupt.
             raise ValueError(
-                "--workers is not supported with multi-host training: the "
-                "worker pool reorders samples per host, breaking the "
-                "identical-global-stream slicing. Drop --workers (decode "
-                "runs in the prefetch thread).")
+                "--workers needs --shard-data under multi-host training: "
+                "the worker pool reorders samples per host, breaking the "
+                "identical-global-stream slicing. Pass --shard-data (each "
+                "host trains on its own 1/N of the data) or drop --workers "
+                "(decode runs in the prefetch thread).")
         if workers >= 1:
             from ..data.mp_loader import MPSampleLoader
-            mp_loader = MPSampleLoader(ds, num_workers=workers,
-                                       seed=tconfig.seed)
+            mp_loader = MPSampleLoader(ds, num_workers=workers, seed=seed)
             sample_iter = iter(mp_loader)
             print(f"[train] {workers} decode/augment worker processes")
         else:
-            sample_iter = ds.sample_iter(seed=tconfig.seed)
-        raw = batched(sample_iter, tconfig.batch_size)
-        batch_iter = PrefetchLoader(_local_slices(raw) if pcount > 1 else raw)
+            sample_iter = ds.sample_iter(seed=seed)
+        raw = batched(sample_iter, local_batch)
+        batch_iter = PrefetchLoader(
+            _local_slices(raw) if (pcount > 1 and not shard_data) else raw)
     else:
         print("[train] no --data: running on RANDOM batches (smoke mode; "
               "use --dataset synthetic for data with real ground truth)")

@@ -135,7 +135,8 @@ def test_small_model_torch_parity_pallas():
     assert err <= 1e-3 + 1e-3 * scale, (err, scale)
 
 
-def test_full_model_gradient_torch_parity():
+@pytest.mark.parametrize("small", [True, False], ids=["small", "full"])
+def test_full_model_gradient_torch_parity(small):
     """Training-fidelity golden: gradients of the SAME scalar loss through
     the official torch model (autograd) and this framework (jax.grad) must
     match leaf-for-leaf.  The torch grads are converted with the SAME
@@ -143,13 +144,16 @@ def test_full_model_gradient_torch_parity():
     backward semantics (BN eval affine, GRU gating, upsampling, corr
     lookup) — not just forward values — breaks this test.  Loss =
     mean(|final flow|): no ground truth needed, gradient flows through
-    every parameter that affects the prediction."""
+    every parameter that affects the prediction.  Covers both variants:
+    raft-small (instance norm, ConvGRU, bilinear upflow) and raft-things
+    (eval-mode BN, SepConvGRU, convex upsampling)."""
     torch.manual_seed(0)
-    tmodel = TorchRAFT(small=True).eval()   # eval: BN running stats fixed
+    tmodel = TorchRAFT(small=small).eval()  # eval: BN running stats fixed
     sd = {k: v.detach().numpy() for k, v in tmodel.state_dict().items()}
     params = from_torch_state_dict(sd)
 
-    cfg = RAFTConfig.small_model(iters=2, compute_dtype="float32")
+    cfg = (RAFTConfig.small_model if small else RAFTConfig.full)(
+        iters=2, compute_dtype="float32")
     params = jax.tree.map(jnp.asarray, params)
 
     rng = np.random.RandomState(3)
@@ -167,10 +171,19 @@ def test_full_model_gradient_torch_parity():
     # differentiate through eval-mode normalization, so they must be SKIPPED
     # below, not compared against fabricated zeros; zero-fill only to keep
     # the converter's tree structure, and build a parallel is-parameter mask
-    # through the same conversion so the skip follows the converted paths
+    # through the same conversion so the skip follows the converted paths.
+    # The full model's shortcut-norm ALIASING (downsample.1.* is the same
+    # parameter as norm3.*, deduped out of named_parameters) needs the grad
+    # copied to the alias name, or the converter's alias-consistency check
+    # would see real grads under one name and zeros under the other.
     pnames = set(grad_sd)
     mask_sd = {}
     for k, v in sd.items():
+        twin = k.replace(".downsample.1.", ".norm3.")
+        if k not in pnames and twin in pnames:
+            grad_sd[k] = grad_sd[twin]
+            mask_sd[k] = np.full_like(v, 1.0)
+            continue
         mask_sd[k] = np.full_like(v, 1.0 if k in pnames else 0.0)
         if k not in pnames:
             grad_sd[k] = np.zeros_like(v)
