@@ -119,16 +119,18 @@ def _cfg_for(name: str):
     tokens = name.split("-")
     window = any(t in ("win", "winpack") for t in tokens)
     pack = any(t in ("pack", "winpack") for t in tokens)
+    ctx = "ctx" in tokens          # -ctx: hoisted GRU context terms
     return RAFTConfig.full(
         corr_impl=impl,
         corr_precision=("default" if name.startswith("pallas-bf16corr")
                         else "highest"),
-        corr_lookup="onehot" if name.endswith("-onehot") else "gather",
-        pallas_lookup_style="vpu" if name.endswith("-vpu") else "matmul",
+        corr_lookup="onehot" if "onehot" in tokens else "gather",
+        pallas_lookup_style="vpu" if "vpu" in tokens else "matmul",
         # window schedule wants fine row-blocks so there is something to skip
         pallas_p_select="window" if window else "all",
         pallas_p_blk=1024 if window else RAFTConfig.full().pallas_p_blk,
         pallas_pack=pack,
+        gru_ctx_hoist=ctx,
         compute_dtype="bfloat16")
 
 
@@ -256,10 +258,11 @@ def _run(args, t_start: float, result: dict) -> None:
     # candidate tuned configurations, best-known-first so a tight budget
     # still measures the likely winner; best one is the headline number
     candidates = ([args.impl] if args.impl
-                  else ["pallas-bf16corr", "pallas-bf16corr-win",
-                        "pallas-bf16corr-winpack", "pallas-bf16corr-pack",
-                        "pallas-bf16corr-vpu", "pallas", "dense-onehot",
-                        "dense", "blockwise-onehot", "blockwise"])
+                  else ["pallas-bf16corr", "pallas-bf16corr-ctx",
+                        "pallas-bf16corr-win", "pallas-bf16corr-winpack",
+                        "pallas-bf16corr-pack", "pallas-bf16corr-vpu",
+                        "pallas", "dense-onehot", "dense",
+                        "blockwise-onehot", "blockwise"])
     if jax.default_backend() != "tpu" and not args.impl:
         # off-TPU the Pallas kernel runs in interpret mode (test-only speed)
         candidates = [c for c in candidates if not c.startswith("pallas")]

@@ -81,6 +81,16 @@ class RAFTConfig:
     # Unrolling lets XLA fuse/overlap across adjacent iterations at the cost
     # of code size; measured on hardware before changing the default.
     scan_unroll: int = 1
+    # Hoist the context contribution out of the GRU gate convolutions: every
+    # gate conv reads [h, inp, motion] and `inp` (the context features) is
+    # iteration-invariant, so its input-channel block is convolved ONCE
+    # before the scan and added per iteration — an exact rewrite (conv is
+    # linear over input-channel blocks) that removes 1/3 of the gate-conv
+    # FLOPs inside the loop (~26% for the small variant).  XLA does not do
+    # this itself (loop-invariant code motion moves whole ops, not partial
+    # contractions).  Identical values (parity-tested); measured knob,
+    # default off until hardware numbers land (TUNING.md).
+    gru_ctx_hoist: bool = False
 
     @property
     def fnet_dim(self) -> int:

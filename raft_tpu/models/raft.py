@@ -34,7 +34,8 @@ from ..ops.corr import (build_pyramid, fmap2_pyramid, lookup_blockwise_onehot,
 from ..ops.upsample import convex_upsample_flow
 from .encoders import apply_encoder, init_encoder
 from .update import (apply_basic_update_block, apply_small_update_block,
-                     init_basic_update_block, init_small_update_block)
+                     init_basic_update_block, init_small_update_block,
+                     precompute_gru_ctx)
 
 
 class RAFTOutput(NamedTuple):
@@ -207,12 +208,20 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
         return convex_upsample_flow(flow_lr.astype(jnp.float32),
                                     mask.astype(jnp.float32))
 
+    gru_ctx = None
+    if config.gru_ctx_hoist:
+        # context terms of the gate convs are iteration-invariant: one conv
+        # each here instead of a third of every in-loop gate contraction
+        gru_ctx = precompute_gru_ctx(params["update_block"]["gru"], inp,
+                                     config.hidden_dim, small=config.small)
+
     def step(carry, _):
         net, coords1, _ = carry
         coords1 = jax.lax.stop_gradient(coords1)   # reference RAFT.py:93 / official
         corr = lookup(coords=coords1).astype(cdt)
         flow = (coords1 - coords0).astype(cdt)
-        net, mask, delta_flow = update_fn(params["update_block"], net, inp, corr, flow)
+        net, mask, delta_flow = update_fn(params["update_block"], net, inp, corr, flow,
+                                          gru_ctx=gru_ctx)
         coords1 = coords1 + delta_flow.astype(jnp.float32)
         out = upsample(coords1 - coords0, mask) if all_flows else None
         return (net, coords1, mask), out

@@ -13,7 +13,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.conv import apply_conv, apply_conv_fused, init_conv
+from ..ops.conv import apply_conv, apply_conv_fused, conv2d, init_conv
 
 
 # ---------------------------------------------------------- motion encoders
@@ -84,6 +84,79 @@ def apply_sep_conv_gru(p: dict, h: jax.Array, x: jax.Array) -> jax.Array:
     return h
 
 
+# --------------------------- context hoisting (config.gru_ctx_hoist)
+#
+# Every gate conv reads hx = [h, inp, motion] (or [r*h, inp, motion] for q),
+# and `inp` — the context-encoder features — never changes across GRU
+# iterations.  Convolution is linear over input-channel blocks, so
+#   conv(hx, W) = conv([h, motion], W_without_inp_cols) + conv(inp, W_inp) + b
+# and the second term (plus the bias) can be computed ONCE before the
+# lax.scan.  This removes the inp third of every gate conv's contraction
+# from the loop body — exact, parameter-layout-untouched (kernels are
+# sliced at apply time, like apply_conv_fused's concatenation).
+
+_SEP_GATES = ("convz1", "convr1", "convq1", "convz2", "convr2", "convq2")
+_GATES = ("convz", "convr", "convq")
+
+
+def precompute_gru_ctx(p: dict, inp: jax.Array, hidden: int,
+                       small: bool = False) -> dict:
+    """One conv per gate over the loop-invariant context features.
+
+    The returned terms carry the gate biases, so the in-loop convs run
+    bias-free.  hx channel layout is [h (hidden), inp (ctx), motion]; the
+    inp block is kernel columns [hidden : hidden + ctx).
+    """
+    lo, hi = hidden, hidden + inp.shape[-1]
+    return {name: conv2d(inp, p[name]["w"][:, :, lo:hi, :], p[name].get("b"))
+            for name in (_GATES if small else _SEP_GATES)}
+
+
+def _gate_loop_w(w: jax.Array, hidden: int, ctx_dim: int) -> jax.Array:
+    """Gate kernel with the context input-channel block removed (the in-loop
+    input is [h, motion]).  Loop-invariant; XLA hoists the concatenation."""
+    return jnp.concatenate([w[:, :, :hidden, :], w[:, :, hidden + ctx_dim:, :]],
+                           axis=2)
+
+
+def _hoisted_gate_step(p: dict, names: Tuple[str, str, str], h: jax.Array,
+                       motion: jax.Array, ctx: dict, hidden: int,
+                       ctx_dim: int) -> jax.Array:
+    """One GRU gate pass with the context terms precomputed: fused z/r conv
+    over [h, motion] (inp columns sliced out), ctx terms added back."""
+    z_name, r_name, q_name = names
+    hm = jnp.concatenate([h, motion], -1)
+    wz = _gate_loop_w(p[z_name]["w"], hidden, ctx_dim)
+    wr = _gate_loop_w(p[r_name]["w"], hidden, ctx_dim)
+    zr = conv2d(hm, jnp.concatenate([wz, wr], axis=3))     # fused z/r
+    z = jax.nn.sigmoid(zr[..., :hidden] + ctx[z_name])
+    r = jax.nn.sigmoid(zr[..., hidden:] + ctx[r_name])
+    wq = _gate_loop_w(p[q_name]["w"], hidden, ctx_dim)
+    q = jnp.tanh(conv2d(jnp.concatenate([r * h, motion], -1), wq)
+                 + ctx[q_name])
+    return (1.0 - z) * h + z * q
+
+
+def apply_sep_conv_gru_hoisted(p: dict, h: jax.Array, motion: jax.Array,
+                               ctx: dict) -> jax.Array:
+    """apply_sep_conv_gru with the context terms precomputed (exact)."""
+    hidden = h.shape[-1]
+    ctx_dim = p["convz1"]["w"].shape[2] - hidden - motion.shape[-1]
+    for suffix in ("1", "2"):        # horizontal (1x5) then vertical (5x1)
+        h = _hoisted_gate_step(
+            p, ("convz" + suffix, "convr" + suffix, "convq" + suffix),
+            h, motion, ctx, hidden, ctx_dim)
+    return h
+
+
+def apply_conv_gru_hoisted(p: dict, h: jax.Array, motion: jax.Array,
+                           ctx: dict) -> jax.Array:
+    """apply_conv_gru with the context terms precomputed (exact)."""
+    hidden = h.shape[-1]
+    ctx_dim = p["convz"]["w"].shape[2] - hidden - motion.shape[-1]
+    return _hoisted_gate_step(p, _GATES, h, motion, ctx, hidden, ctx_dim)
+
+
 def init_conv_gru(key, hidden: int, input_dim: int) -> dict:
     k = jax.random.split(key, 3)
     hx = hidden + input_dim
@@ -140,11 +213,15 @@ def init_basic_update_block(key, corr_dim: int, hidden_dim: int = 128,
 
 
 def apply_basic_update_block(p: dict, net: jax.Array, inp: jax.Array,
-                             corr: jax.Array, flow: jax.Array
+                             corr: jax.Array, flow: jax.Array,
+                             gru_ctx: Optional[dict] = None
                              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     motion = apply_basic_motion_encoder(p["encoder"], flow, corr)
-    x = jnp.concatenate([inp, motion], -1)
-    net = apply_sep_conv_gru(p["gru"], net, x)
+    if gru_ctx is not None:      # inp's gate-conv terms precomputed outside
+        net = apply_sep_conv_gru_hoisted(p["gru"], net, motion, gru_ctx)
+    else:
+        x = jnp.concatenate([inp, motion], -1)
+        net = apply_sep_conv_gru(p["gru"], net, x)
     # flow head conv1 and mask head [0] both read `net` with 3x3 kernels ->
     # one fused conv (exact), then each branch's own tail
     fh, mh = apply_conv_fused((p["flow_head"]["conv1"], p["mask"]["0"]), net)
@@ -164,10 +241,14 @@ def init_small_update_block(key, corr_dim: int, hidden_dim: int = 96,
 
 
 def apply_small_update_block(p: dict, net: jax.Array, inp: jax.Array,
-                             corr: jax.Array, flow: jax.Array
+                             corr: jax.Array, flow: jax.Array,
+                             gru_ctx: Optional[dict] = None
                              ) -> Tuple[jax.Array, Optional[jax.Array], jax.Array]:
     motion = apply_small_motion_encoder(p["encoder"], flow, corr)
-    x = jnp.concatenate([inp, motion], -1)
-    net = apply_conv_gru(p["gru"], net, x)
+    if gru_ctx is not None:      # inp's gate-conv terms precomputed outside
+        net = apply_conv_gru_hoisted(p["gru"], net, motion, gru_ctx)
+    else:
+        x = jnp.concatenate([inp, motion], -1)
+        net = apply_conv_gru(p["gru"], net, x)
     delta_flow = apply_flow_head(p["flow_head"], net)
     return net, None, delta_flow

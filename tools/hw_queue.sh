@@ -1,0 +1,45 @@
+#!/bin/bash
+# Unattended hardware-validation queue (VERDICT round-2 item 1).
+#
+# Runs the full round-3 capture in the mandated order the moment the TPU
+# data plane is back, logging everything under artifacts/hw_r3/.  Each
+# stage gets its own timeout so one hang cannot eat the tunnel window;
+# stages are independent (a failed sweep still lets bench.py run).
+#
+# Launch manually or let tools/tpu_probe_loop.sh trigger it on EXEC_OK.
+set -u
+cd "$(dirname "$0")/.."
+OUT=artifacts/hw_r3
+mkdir -p "$OUT"
+MARKER="$OUT/.queue_started"
+if [ -e "$MARKER" ]; then
+  echo "hw_queue already started ($(cat "$MARKER")); remove $MARKER to rerun"
+  exit 0
+fi
+date -u +%Y-%m-%dT%H:%M:%SZ > "$MARKER"
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name: $* (timeout ${tmo}s) ==="
+  { date -u +%Y-%m-%dT%H:%M:%SZ; timeout "$tmo" "$@" 2>&1; \
+    echo "rc=$? $(date -u +%H:%M:%SZ)"; } >> "$OUT/$name.log"
+  tail -1 "$OUT/$name.log"
+}
+
+# 1. Mosaic lowering parity — highest-risk unknown, run first.
+run hw_smoke       1500 python tools/hw_smoke.py --full
+# 2. Null-call floor + per-stage attribution (eval + train shapes).
+run profile_eval   1500 python tools/profile_breakdown.py
+run profile_train  1500 python tools/profile_breakdown.py --size 368 496 --batch 6
+# 3. Window/pack sweeps (quick: the full grid was measured in round 2;
+#    only the new schedules need numbers).
+run tune_window    1800 python tools/tune_pallas.py --quick --precision default --p-select window
+run tune_winpack   1800 python tools/tune_pallas.py --quick --precision default --p-select window --pack
+run tune_pack      1800 python tools/tune_pallas.py --quick --precision default --pack
+# 4. Headline inference bench (writes its own JSON line).
+run bench          2400 python bench.py
+# 5. Train-step throughput at the official shape, incl. accum overhead.
+run bench_train    1800 python tools/bench_train.py
+run bench_train_ctx 1200 python tools/bench_train.py --impl pallas-bf16corr-ctx
+run bench_accum    1200 python tools/bench_train.py --accum 2
+echo "hw_queue complete $(date -u +%H:%M:%SZ)"

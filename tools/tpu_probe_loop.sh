@@ -1,0 +1,24 @@
+#!/bin/bash
+# Detached TPU-tunnel probe: every 300 s, try a real matmul execution
+# (not just device enumeration -- the tunnel can be half-up, where
+# jax.devices() succeeds but execute hangs).  Appends one line per probe
+# to the log; a line containing EXEC_OK means the data plane is back.
+LOG=${1:-/tmp/tpu_probe.log}
+while true; do
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  out=$(timeout 150 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256)); y = (x @ x).block_until_ready()
+print('EXEC_OK', float(y[0, 0]))
+" 2>&1 | grep -E "EXEC_OK|Error|error" | head -2)
+  if echo "$out" | grep -q EXEC_OK; then
+    echo "$ts EXEC_OK" >> "$LOG"
+    # data plane is back: fire the capture queue once (it self-guards
+    # with a marker file, so repeat EXEC_OK lines are no-ops)
+    setsid nohup bash "$(dirname "$0")/hw_queue.sh" \
+      >> "${LOG%.log}.queue.log" 2>&1 < /dev/null &
+  else
+    echo "$ts DOWN ${out:0:120}" >> "$LOG"
+  fi
+  sleep 300
+done
