@@ -101,15 +101,30 @@ _GATES = ("convz", "convr", "convq")
 
 def precompute_gru_ctx(p: dict, inp: jax.Array, hidden: int,
                        small: bool = False) -> dict:
-    """One conv per gate over the loop-invariant context features.
+    """The gate convs' terms over the loop-invariant context features.
 
     The returned terms carry the gate biases, so the in-loop convs run
     bias-free.  hx channel layout is [h (hidden), inp (ctx), motion]; the
-    inp block is kernel columns [hidden : hidden + ctx).
+    inp block is kernel columns [hidden : hidden + ctx).  Gates sharing a
+    kernel shape read the same input, so each shape group runs as ONE
+    fused conv (apply_conv_fused): z1/r1/q1 (1x5), z2/r2/q2 (5x1), or all
+    three 3x3 gates of the small variant.
     """
     lo, hi = hidden, hidden + inp.shape[-1]
-    return {name: conv2d(inp, p[name]["w"][:, :, lo:hi, :], p[name].get("b"))
-            for name in (_GATES if small else _SEP_GATES)}
+
+    def sliced(name: str) -> dict:
+        q = {"w": p[name]["w"][:, :, lo:hi, :]}
+        if "b" in p[name]:
+            q["b"] = p[name]["b"]
+        return q
+
+    groups = ((_GATES,) if small
+              else (_SEP_GATES[:3], _SEP_GATES[3:]))
+    out = {}
+    for names in groups:
+        terms = apply_conv_fused([sliced(n) for n in names], inp)
+        out.update(dict(zip(names, terms)))
+    return out
 
 
 def _gate_loop_w(w: jax.Array, hidden: int, ctx_dim: int) -> jax.Array:

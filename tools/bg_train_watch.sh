@@ -1,0 +1,23 @@
+#!/bin/bash
+# Run a (resumable) CPU training job at low priority, killing it the moment
+# the hardware-capture queue starts so background compute can never pollute
+# TPU timings.  Usage: bg_train_watch.sh <outdir> <train-args...>
+set -u
+cd "$(dirname "$0")/.."
+OUT=$1; shift
+MARKER=artifacts/hw_r3/.queue_started
+mkdir -p "$OUT"
+nice -n 19 python -m raft_tpu.cli -m train "$@" --out "$OUT" \
+  >> "$OUT/train.log" 2>&1 &
+PID=$!
+echo "train pid $PID" >> "$OUT/train.log"
+while kill -0 "$PID" 2>/dev/null; do
+  if [ -e "$MARKER" ]; then
+    echo "hw queue started; stopping background training" >> "$OUT/train.log"
+    kill -TERM "$PID"
+    break
+  fi
+  sleep 60
+done
+wait "$PID" 2>/dev/null
+echo "train exited rc=$? $(date -u +%H:%M:%SZ)" >> "$OUT/train.log"

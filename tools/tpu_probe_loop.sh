@@ -3,9 +3,25 @@
 # (not just device enumeration -- the tunnel can be half-up, where
 # jax.devices() succeeds but execute hangs).  Appends one line per probe
 # to the log; a line containing EXEC_OK means the data plane is back.
+#
+# On EXEC_OK it fires tools/hw_queue.sh (re-entrant, resumes unfinished
+# stages).  While the queue holds its lock the probe SKIPS the matmul --
+# the TPU is single-owner and a probe between queue stages could steal
+# the device from the next stage.  Once the queue writes .queue_done the
+# loop retires.
 LOG=${1:-/tmp/tpu_probe.log}
+QDIR="$(cd "$(dirname "$0")/.." && pwd)/artifacts/hw_r3"
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  if [ -e "$QDIR/.queue_done" ]; then
+    echo "$ts queue done; probe loop retiring" >> "$LOG"
+    exit 0
+  fi
+  if [ -e "$QDIR/.queue_lock" ] && ! flock -n "$QDIR/.queue_lock" true; then
+    echo "$ts QUEUE_RUNNING (probe skipped)" >> "$LOG"
+    sleep 300
+    continue
+  fi
   out=$(timeout 150 python -c "
 import jax, jax.numpy as jnp
 x = jnp.ones((256, 256)); y = (x @ x).block_until_ready()
@@ -13,8 +29,6 @@ print('EXEC_OK', float(y[0, 0]))
 " 2>&1 | grep -E "EXEC_OK|Error|error" | head -2)
   if echo "$out" | grep -q EXEC_OK; then
     echo "$ts EXEC_OK" >> "$LOG"
-    # data plane is back: fire the capture queue once (it self-guards
-    # with a marker file, so repeat EXEC_OK lines are no-ops)
     setsid nohup bash "$(dirname "$0")/hw_queue.sh" \
       >> "${LOG%.log}.queue.log" 2>&1 < /dev/null &
   else
