@@ -10,9 +10,17 @@
 # the device from the next stage.  Once the queue writes .queue_done the
 # loop retires.
 LOG=${1:-/tmp/tpu_probe.log}
+# Optional absolute deadline (epoch seconds): after it, stop probing and
+# firing — the round driver needs sole TPU ownership for its own bench run.
+DEADLINE=${2:-0}
 QDIR="$(cd "$(dirname "$0")/.." && pwd)/artifacts/hw_r3"
+[ "$DEADLINE" -gt 0 ] && echo "$DEADLINE" > "$QDIR/.deadline"
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  if [ "$DEADLINE" -gt 0 ] && [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "$ts deadline reached; probe loop retiring" >> "$LOG"
+    exit 0
+  fi
   if [ -e "$QDIR/.queue_done" ]; then
     echo "$ts queue done; probe loop retiring" >> "$LOG"
     exit 0
