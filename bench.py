@@ -261,11 +261,13 @@ def _run(args, t_start: float, result: dict) -> None:
                   else ["pallas-bf16corr", "pallas-bf16corr-ctx",
                         "pallas-bf16corr-win", "pallas-bf16corr-winpack",
                         "pallas-bf16corr-pack", "pallas-bf16corr-vpu",
-                        "pallas", "dense-onehot", "dense",
-                        "blockwise-onehot", "blockwise"])
+                        "pallas", "dense-onehot", "dense-onehot-ctx",
+                        "dense", "blockwise-onehot", "blockwise"])
     if jax.default_backend() != "tpu" and not args.impl:
-        # off-TPU the Pallas kernel runs in interpret mode (test-only speed)
+        # off-TPU the Pallas kernel runs in interpret mode (test-only speed);
+        # ctx hoisting won the CPU spot checks, so try it first there
         candidates = [c for c in candidates if not c.startswith("pallas")]
+        candidates.sort(key=lambda c: 0 if "ctx" in c.split("-") else 1)
 
     best_name, best, best_mfu = None, -1.0, None
     for name in candidates:

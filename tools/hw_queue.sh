@@ -22,15 +22,15 @@ flock -n 9 || { echo "hw_queue already running"; exit 0; }
 all_ok=1
 run() {  # run <name> <timeout_s> <cmd...>
   local name=$1 tmo=$2; shift 2
+  if grep -q '^rc=0 ' "$OUT/$name.log" 2>/dev/null; then
+    echo "=== $name: already done, skipping ==="; return
+  fi
   # respect the probe loop's absolute deadline: never start a stage that
   # could still hold the TPU when the round driver needs it
   local dl
   dl=$(cat "$OUT/.deadline" 2>/dev/null || echo 0)
   if [ "$dl" -gt 0 ] && [ "$(($(date +%s) + tmo))" -ge "$dl" ]; then
     echo "=== $name: would overrun the deadline, skipping ==="; all_ok=0; return
-  fi
-  if grep -q '^rc=0 ' "$OUT/$name.log" 2>/dev/null; then
-    echo "=== $name: already done, skipping ==="; return
   fi
   if [ "$(grep -c '^rc=' "$OUT/$name.log" 2>/dev/null)" -ge 3 ]; then
     echo "=== $name: 3 failed attempts, giving up ==="; return

@@ -14,7 +14,10 @@ LOG=${1:-/tmp/tpu_probe.log}
 # firing — the round driver needs sole TPU ownership for its own bench run.
 DEADLINE=${2:-0}
 QDIR="$(cd "$(dirname "$0")/.." && pwd)/artifacts/hw_r3"
-[ "$DEADLINE" -gt 0 ] && echo "$DEADLINE" > "$QDIR/.deadline"
+mkdir -p "$QDIR"
+# always (over)write: a stale deadline from a previous round must not
+# outlive the loop that set it — DEADLINE=0 disarms the queue-side guard
+echo "$DEADLINE" > "$QDIR/.deadline"
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   if [ "$DEADLINE" -gt 0 ] && [ "$(date +%s)" -ge "$DEADLINE" ]; then
