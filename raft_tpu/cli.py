@@ -48,6 +48,10 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--corr-impl", default="dense",
                    choices=["dense", "blockwise", "pallas"])
     p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
+    p.add_argument("--ctx-hoist", action="store_true",
+                   help="precompute the GRU gate convs' context terms outside "
+                        "the iteration loop (exact rewrite; measured perf "
+                        "knob — see TUNING.md)")
     p.add_argument("--rgb", action="store_true",
                    help="input is RGB (default BGR, matching the reference)")
     p.add_argument("--save-flo", action="store_true", help="also write .flo")
@@ -136,7 +140,8 @@ def _build_parser() -> argparse.ArgumentParser:
 
 def _make_config(args):
     from .config import RAFTConfig
-    overrides = dict(corr_impl=args.corr_impl, compute_dtype=args.dtype)
+    overrides = dict(corr_impl=args.corr_impl, compute_dtype=args.dtype,
+                     gru_ctx_hoist=args.ctx_hoist)
     if args.iters is not None:
         overrides["iters"] = args.iters
     if args.small:

@@ -22,6 +22,20 @@ def test_mode_test_writes_png(tmp_path, capsys):
     assert im.shape == (48, 64, 3)
 
 
+def test_mode_test_ctx_hoist_matches_plain(tmp_path, capsys):
+    """--ctx-hoist is an exact rewrite: the written flow PNG must match the
+    plain run pixel-for-pixel up to colorization rounding."""
+    import cv2
+    import numpy as np
+    a_dir, b_dir = tmp_path / "a", tmp_path / "b"
+    common = ["-m", "test", "--small", "--iters", "2", "--size", "48", "64"]
+    assert cli.main(common + ["--out", str(a_dir)]) == 0
+    assert cli.main(common + ["--ctx-hoist", "--out", str(b_dir)]) == 0
+    a = cv2.imread(str(a_dir / "raft_flow_raft-small.png")).astype(np.int16)
+    b = cv2.imread(str(b_dir / "raft_flow_raft-small.png")).astype(np.int16)
+    assert np.abs(a - b).max() <= 2, np.abs(a - b).max()
+
+
 def test_train_warm_start_from_checkpoint(tmp_path, capsys):
     """-m train --load warm-starts from existing weights (the official
     curriculum chains stages this way: things --load's chairs, etc.).

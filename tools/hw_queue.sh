@@ -28,12 +28,14 @@ run() {  # run <name> <timeout_s> <cmd...>
   # respect the probe loop's absolute deadline: never start a stage that
   # could still hold the TPU when the round driver needs it
   local dl
-  dl=$(cat "$OUT/.deadline" 2>/dev/null || echo 0)
+  dl=$(cut -d' ' -f1 "$OUT/.deadline" 2>/dev/null || echo 0)
+  dl=${dl:-0}
   if [ "$dl" -gt 0 ] && [ "$(($(date +%s) + tmo))" -ge "$dl" ]; then
     echo "=== $name: would overrun the deadline, skipping ==="; all_ok=0; return
   fi
   if [ "$(grep -c '^rc=' "$OUT/$name.log" 2>/dev/null)" -ge 3 ]; then
-    echo "=== $name: 3 failed attempts, giving up ==="; return
+    # still incomplete: .queue_done must not claim a full capture
+    echo "=== $name: 3 failed attempts, giving up ==="; all_ok=0; return
   fi
   echo "=== $name: $* (timeout ${tmo}s) ==="
   { date -u +%Y-%m-%dT%H:%M:%SZ; timeout "$tmo" "$@" 2>&1; \

@@ -15,9 +15,18 @@ LOG=${1:-/tmp/tpu_probe.log}
 DEADLINE=${2:-0}
 QDIR="$(cd "$(dirname "$0")/.." && pwd)/artifacts/hw_r3"
 mkdir -p "$QDIR"
-# always (over)write: a stale deadline from a previous round must not
-# outlive the loop that set it — DEADLINE=0 disarms the queue-side guard
-echo "$DEADLINE" > "$QDIR/.deadline"
+# The deadline file records "epoch owner_pid".  An armed loop always writes
+# its own deadline; a deadline-less loop clears a leftover value only if the
+# recorded owner is dead — so it cannot disarm a live loop's guard, but a
+# stale epoch from a previous round cannot silently skip every queue stage.
+if [ "$DEADLINE" -gt 0 ]; then
+  echo "$DEADLINE $$" > "$QDIR/.deadline"
+else
+  owner=$(cut -d' ' -f2 "$QDIR/.deadline" 2>/dev/null)
+  if [ -z "$owner" ] || ! kill -0 "$owner" 2>/dev/null; then
+    echo "0 $$" > "$QDIR/.deadline"
+  fi
+fi
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   if [ "$DEADLINE" -gt 0 ] && [ "$(date +%s)" -ge "$DEADLINE" ]; then
