@@ -250,6 +250,27 @@ def test_shard_inference_matches_single_device(small):
                                atol=2e-2, rtol=1e-3)
 
 
+def test_shard_inference_ctx_hoist_matches_single_device():
+    """gru_ctx_hoist composes with row-sharding: the precompute convs run on
+    sharded `inp` (halo exchanges for the 5x1/3x3 gate kernels) and must
+    still match the unsharded plain forward."""
+    from raft_tpu.parallel import make_shard_inference_fn
+
+    plain = RAFTConfig.small_model(iters=2)
+    hoisted = RAFTConfig.small_model(iters=2, gru_ctx_hoist=True)
+    params = init_raft(jax.random.PRNGKey(0), plain)
+    rng = np.random.RandomState(5)
+    im1 = jnp.asarray(rng.rand(1, 256, 48, 3), jnp.float32)
+    im2 = jnp.asarray(rng.rand(1, 256, 48, 3), jnp.float32)
+    want = jax.jit(make_inference_fn(plain))(params, im1, im2)
+
+    mesh = make_mesh(axes=(SPATIAL_AXIS,), shape=(4,),
+                     devices=jax.devices()[:4])
+    got = make_shard_inference_fn(hoisted, mesh)(params, im1, im2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-2, rtol=1e-3)
+
+
 def test_shard_inference_halo_wider_than_slab():
     """Tiny slabs (2 rows at 1/8 res) force the 7x7 conv's halo (3) past the
     neighbor exchange — the all_gather fallback must keep exact parity."""

@@ -71,6 +71,18 @@ def test_full_model_torch_parity(small):
             f"iter {i}: max|Δflow|={err:.2e} vs scale {scale:.2e}")
 
 
+def test_full_model_torch_parity_ctx_hoist():
+    """The hoisted-context GRU rewrite must match the official architecture
+    directly (not just the plain JAX path): same oracle, same gate."""
+    tflows, jflows = _run_pair(False, B=1, H=128, W=128, iters=3,
+                               gru_ctx_hoist=True)
+    for i, (tf_i, jf_i) in enumerate(zip(tflows, jflows)):
+        err = np.abs(tf_i - jf_i).max()
+        scale = np.abs(tf_i).max()
+        assert err <= 1e-3 + 1e-3 * scale, (
+            f"iter {i}: max|Δflow|={err:.2e} vs scale {scale:.2e}")
+
+
 def test_full_model_torch_parity_blockwise_onehot():
     """The tuned lookup paths must match the official model too, not just
     the dense/gather correctness reference."""

@@ -15,17 +15,24 @@ LOG=${1:-/tmp/tpu_probe.log}
 DEADLINE=${2:-0}
 QDIR="$(cd "$(dirname "$0")/.." && pwd)/artifacts/hw_r3"
 mkdir -p "$QDIR"
-# The deadline file records "epoch owner_pid".  An armed loop always writes
-# its own deadline; a deadline-less loop clears a leftover value only if the
-# recorded owner is dead — so it cannot disarm a live loop's guard, but a
-# stale epoch from a previous round cannot silently skip every queue stage.
+# The deadline file records "epoch owner_pid".  An armed loop writes its
+# deadline and removes it on exit (trap), so stale armed deadlines cannot
+# outlive their loop; a deadline-less loop clears a leftover value (e.g.
+# after SIGKILL, where the trap never ran) only if the recorded owner is
+# dead.  Writes go through a dedicated flock so two loops starting
+# concurrently cannot clobber each other's state.
 if [ "$DEADLINE" -gt 0 ]; then
-  echo "$DEADLINE $$" > "$QDIR/.deadline"
+  ( flock -w 10 8; echo "$DEADLINE $$" > "$QDIR/.deadline"
+  ) 8>>"$QDIR/.deadline_lock"
+  trap 'rm -f "$QDIR/.deadline"' EXIT
+  trap 'exit 143' TERM INT
 else
-  owner=$(cut -d' ' -f2 "$QDIR/.deadline" 2>/dev/null)
-  if [ -z "$owner" ] || ! kill -0 "$owner" 2>/dev/null; then
-    echo "0 $$" > "$QDIR/.deadline"
-  fi
+  ( flock -w 10 8
+    owner=$(cut -d' ' -f2 "$QDIR/.deadline" 2>/dev/null)
+    if [ -z "$owner" ] || ! kill -0 "$owner" 2>/dev/null; then
+      echo "0 $$" > "$QDIR/.deadline"
+    fi
+  ) 8>>"$QDIR/.deadline_lock"
 fi
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
