@@ -104,6 +104,15 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="training crop size (default: the stage preset's "
                         "crop, e.g. 368x496 chairs / 400x720 things; "
                         "96x128 for synthetic)")
+    p.add_argument("--mp-start", default="fork",
+                   choices=["fork", "forkserver", "spawn"],
+                   help="worker start method: fork inherits the dataset "
+                        "copy-on-write; forkserver/spawn are fork-safe on "
+                        "heavily threaded hosts (JAX/BLAS locks)")
+    p.add_argument("--stall-timeout", type=float, default=300.0,
+                   help="abort if live data workers deliver nothing for this "
+                        "many seconds (deadlock/stalled-storage detection); "
+                        "0 disables")
     p.add_argument("--workers", type=int, default=0,
                    help="decode/augment worker processes (0 = in-line in the "
                         "prefetch thread); the PrefetchDataZMQ analog")

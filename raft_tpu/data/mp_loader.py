@@ -9,8 +9,15 @@ over bounded queues, so augmentation scales across cores while the batching /
 device staging stays in the main process (pipeline.PrefetchLoader).
 
 Design notes:
-* fork start method — workers inherit the dataset by COW, no pickling of the
-  file lists; workers touch only numpy/cv2, never jax.
+* start method is a knob: "fork" (default) inherits the dataset by COW with
+  no pickling, but a fork taken while the parent's JAX/BLAS threads hold
+  locks can deadlock the child (observed in practice: worker alive, zero
+  CPU, forever); "forkserver"/"spawn" pay a pickle+startup cost for
+  fork-safety on heavily threaded hosts.  Either way the workers touch only
+  numpy/cv2, never jax.
+* stall detection — death detection catches workers that DIED; a deadlocked
+  worker is alive and silent, so the iterator also raises if all workers
+  are alive yet nothing arrives for ``stall_timeout`` seconds.
 * per-sample determinism — each task carries a seed derived from (loader
   seed, epoch, index) and reseeds the augmentor's RandomState before the
   item is produced, so sample *content* is reproducible even though arrival
@@ -58,10 +65,16 @@ class MPSampleLoader:
     def __init__(self, dataset, num_workers: int = 4, seed: int = 0,
                  shuffle: bool = True, epochs: Optional[int] = None,
                  queue_depth: Optional[int] = None,
-                 poll_timeout: float = 10.0):
+                 poll_timeout: float = 10.0,
+                 stall_timeout: Optional[float] = 300.0,
+                 start_method: str = "fork"):
         assert num_workers >= 1
+        if start_method not in ("fork", "forkserver", "spawn"):
+            raise ValueError(f"start_method must be fork/forkserver/spawn, "
+                             f"got {start_method!r}")
         self._poll_timeout = poll_timeout
-        ctx = mp.get_context("fork")
+        self._stall_timeout = stall_timeout
+        ctx = mp.get_context(start_method)
         depth = queue_depth or 2 * num_workers
         self._tasks = ctx.Queue(maxsize=depth)
         self._results = ctx.Queue(maxsize=depth)
@@ -98,11 +111,13 @@ class MPSampleLoader:
 
     def __iter__(self) -> Iterator:
         served = 0
+        last_progress = time.monotonic()
         while self._n_tasks is None or served < self._n_tasks:
             while True:
                 try:
                     status, payload = self._results.get(
                         timeout=self._poll_timeout)
+                    last_progress = time.monotonic()
                     break
                 except queue.Empty:
                     # a worker killed by the OS (segfault, OOM killer) never
@@ -113,6 +128,20 @@ class MPSampleLoader:
                         raise RuntimeError(
                             "all data workers died without reporting (killed "
                             "by the OS? check dmesg for OOM)") from None
+                    # ... and a DEADLOCKED worker is alive yet silent (e.g.
+                    # a fork taken while the parent's JAX/BLAS threads held
+                    # locks): raise instead of polling forever
+                    stalled = time.monotonic() - last_progress
+                    if (self._stall_timeout is not None
+                            and stalled > self._stall_timeout):
+                        self.close()
+                        raise RuntimeError(
+                            f"data workers alive but produced nothing for "
+                            f"{stalled:.0f}s — either storage is stalled "
+                            f"(raise stall_timeout / --stall-timeout, 0 "
+                            f"disables) or the fork deadlocked (threads "
+                            f"held locks at fork time; retry with "
+                            f"start_method='forkserver' or 'spawn')") from None
             if status == "error":
                 self.close()
                 raise RuntimeError(f"data worker failed:\n{payload}")

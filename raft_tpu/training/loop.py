@@ -334,7 +334,11 @@ def train_cli(args, config: RAFTConfig) -> int:
                 "(decode runs in the prefetch thread).")
         if workers >= 1:
             from ..data.mp_loader import MPSampleLoader
-            mp_loader = MPSampleLoader(ds, num_workers=workers, seed=seed)
+            stall = getattr(args, "stall_timeout", 300.0)
+            mp_loader = MPSampleLoader(
+                ds, num_workers=workers, seed=seed,
+                start_method=getattr(args, "mp_start", "fork"),
+                stall_timeout=None if not stall else stall)
             sample_iter = iter(mp_loader)
             print(f"[train] {workers} decode/augment worker processes")
         else:

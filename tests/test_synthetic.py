@@ -144,6 +144,46 @@ def test_mp_loader_detects_silent_worker_death():
         loader.close()
 
 
+class _Hanging:
+    """Dataset whose reads block forever — models a worker that is alive but
+    deadlocked (e.g. a fork taken while parent threads held locks)."""
+    augmentor = None
+
+    def __len__(self):
+        return 10
+
+    def __getitem__(self, idx):
+        import time as _t
+        while True:
+            _t.sleep(3600)
+
+
+def test_mp_loader_detects_alive_but_stalled_workers():
+    """A deadlocked worker is ALIVE, so death detection never fires; the
+    stall detector must raise instead of polling forever."""
+    loader = MPSampleLoader(_Hanging(), num_workers=2, seed=0, shuffle=False,
+                            epochs=1, poll_timeout=0.2, stall_timeout=1.5)
+    with pytest.raises(RuntimeError, match="produced nothing"):
+        for _ in loader:
+            pass
+
+
+def test_mp_loader_forkserver_start_method():
+    """forkserver workers (fork-safe on threaded hosts) deliver the same
+    multiset of samples as the in-process dataset."""
+    ds = SyntheticFlowDataset(size=(32, 48), length=3, seed=0)
+    expected = {ds[i][2].tobytes() for i in range(3)}
+    loader = MPSampleLoader(ds, num_workers=2, seed=0, epochs=1,
+                            start_method="forkserver")
+    got = set()
+    try:
+        for sample in loader:
+            got.add(sample[2].tobytes())
+    finally:
+        loader.close()
+    assert got == expected
+
+
 def test_mp_loader_close_unblocks_feeder():
     """Closing an infinite loader mid-stream must not leak the feeder."""
     ds = SyntheticFlowDataset(size=(32, 48), length=6, seed=0)
