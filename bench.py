@@ -55,15 +55,19 @@ def _peak_flops(device_kind: str):
 
 def _probe_tpu(timeout_s: float) -> str | None:
     """Initialize the TPU backend in a THROWAWAY SUBPROCESS first.  The axon
-    tunnel backend has been observed both to raise UNAVAILABLE (BENCH_r01)
-    and to hang indefinitely inside jax.devices() — an in-process call can
-    therefore wedge past any driver timeout with no JSON emitted.  A probe
-    subprocess converts both failure modes into a recoverable signal.
-    Returns None if the backend is usable, else a description."""
+    tunnel backend has been observed to raise UNAVAILABLE (BENCH_r01), to
+    hang inside jax.devices(), AND to come up HALF-way — device enumeration
+    succeeds but any execution hangs forever (observed 2026-07-31) — so the
+    probe must run a real computation with a host readback, not just list
+    devices.  An in-process call can wedge past any driver timeout with no
+    JSON emitted; a probe subprocess converts every failure mode into a
+    recoverable signal.  Returns None if the backend is usable, else a
+    description."""
     import subprocess
 
-    code = ("import jax; d = jax.devices(); "
-            "print(d[0].platform, d[0].device_kind)")
+    code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
+            "x = jnp.ones((128, 128)); v = float((x @ x)[0, 0]); "
+            "print(d[0].platform, d[0].device_kind, v)")
     try:
         proc = subprocess.run([sys.executable, "-c", code],
                               capture_output=True, text=True,
