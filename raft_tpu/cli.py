@@ -104,11 +104,11 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="training crop size (default: the stage preset's "
                         "crop, e.g. 368x496 chairs / 400x720 things; "
                         "96x128 for synthetic)")
-    p.add_argument("--mp-start", default="fork",
+    p.add_argument("--mp-start", default="forkserver",
                    choices=["fork", "forkserver", "spawn"],
-                   help="worker start method: fork inherits the dataset "
-                        "copy-on-write; forkserver/spawn are fork-safe on "
-                        "heavily threaded hosts (JAX/BLAS locks)")
+                   help="worker start method (default forkserver: fork-safe "
+                        "under JAX's threads); fork inherits the dataset "
+                        "copy-on-write but can deadlock in a threaded parent")
     p.add_argument("--stall-timeout", type=float, default=300.0,
                    help="abort if live data workers deliver nothing for this "
                         "many seconds (deadlock/stalled-storage detection); "

@@ -9,12 +9,16 @@ over bounded queues, so augmentation scales across cores while the batching /
 device staging stays in the main process (pipeline.PrefetchLoader).
 
 Design notes:
-* start method is a knob: "fork" (default) inherits the dataset by COW with
-  no pickling, but a fork taken while the parent's JAX/BLAS threads hold
-  locks can deadlock the child (observed in practice: worker alive, zero
-  CPU, forever); "forkserver"/"spawn" pay a pickle+startup cost for
-  fork-safety on heavily threaded hosts.  Either way the workers touch only
-  numpy/cv2, never jax.
+* start method is a knob, default "forkserver": the loader always runs
+  inside a JAX process, and JAX is always multithreaded, so a plain fork
+  can land while another thread holds a lock and deadlock the child
+  (observed twice in one day: worker alive, zero CPU, forever — the
+  CPython fork-under-threads warning is not theoretical).  forkserver
+  forks workers from a clean early-spawned server instead, at the cost of
+  pickling the dataset (file lists + augmentor state — cheap).  "fork"
+  remains opt-in for maximal copy-on-write when the caller knows the
+  parent is single-threaded; "spawn" is the portable fallback.  Either
+  way the workers touch only numpy/cv2, never jax.
 * stall detection — death detection catches workers that DIED; a deadlocked
   worker is alive and silent, so the iterator also raises if all workers
   are alive yet nothing arrives for ``stall_timeout`` seconds.
@@ -67,13 +71,14 @@ class MPSampleLoader:
                  queue_depth: Optional[int] = None,
                  poll_timeout: float = 10.0,
                  stall_timeout: Optional[float] = 300.0,
-                 start_method: str = "fork"):
+                 start_method: str = "forkserver"):
         assert num_workers >= 1
         if start_method not in ("fork", "forkserver", "spawn"):
             raise ValueError(f"start_method must be fork/forkserver/spawn, "
                              f"got {start_method!r}")
         self._poll_timeout = poll_timeout
         self._stall_timeout = stall_timeout
+        self._start_method = start_method
         ctx = mp.get_context(start_method)
         depth = queue_depth or 2 * num_workers
         self._tasks = ctx.Queue(maxsize=depth)
@@ -135,13 +140,15 @@ class MPSampleLoader:
                     if (self._stall_timeout is not None
                             and stalled > self._stall_timeout):
                         self.close()
+                        hint = ("storage is stalled (raise stall_timeout / "
+                                "--stall-timeout, 0 disables)")
+                        if self._start_method == "fork":
+                            hint += (", or the fork deadlocked (threads held "
+                                     "locks at fork time; retry with "
+                                     "start_method='forkserver' or 'spawn')")
                         raise RuntimeError(
                             f"data workers alive but produced nothing for "
-                            f"{stalled:.0f}s — either storage is stalled "
-                            f"(raise stall_timeout / --stall-timeout, 0 "
-                            f"disables) or the fork deadlocked (threads "
-                            f"held locks at fork time; retry with "
-                            f"start_method='forkserver' or 'spawn')") from None
+                            f"{stalled:.0f}s — likely {hint}") from None
             if status == "error":
                 self.close()
                 raise RuntimeError(f"data worker failed:\n{payload}")

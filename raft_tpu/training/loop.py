@@ -337,7 +337,7 @@ def train_cli(args, config: RAFTConfig) -> int:
             stall = getattr(args, "stall_timeout", 300.0)
             mp_loader = MPSampleLoader(
                 ds, num_workers=workers, seed=seed,
-                start_method=getattr(args, "mp_start", "fork"),
+                start_method=getattr(args, "mp_start", "forkserver"),
                 stall_timeout=None if not stall else stall)
             sample_iter = iter(mp_loader)
             print(f"[train] {workers} decode/augment worker processes")
