@@ -81,10 +81,16 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="val mode: evaluate only the first N samples "
                         "(quick spot checks on big datasets)")
     p.add_argument("--dump-flow", default=None, metavar="DIR",
-                   help="val mode: also write every prediction to DIR, in "
-                        "dataset order — 16-bit flow PNG encoding for "
-                        "--dataset kitti, .flo otherwise (rename per the "
-                        "KITTI devkit scheme for a server submission)")
+                   help="val mode: also write every prediction to DIR — "
+                        "16-bit flow PNG encoding for --dataset kitti "
+                        "(devkit <frame>_10.png naming, directly server-"
+                        "submittable), .flo named frame_<idx:06d> otherwise")
+    p.add_argument("--split", default=None,
+                   choices=["training", "testing"],
+                   help="val mode, --dataset kitti: which split to run "
+                        "(default training; 'testing' has no ground truth — "
+                        "metrics are skipped and --dump-flow is required, "
+                        "producing the KITTI server submission directory)")
     p.add_argument("--eval-batch", type=int, default=None, metavar="N",
                    help="val mode: samples per device call, grouped by "
                         "padded shape (identical metrics; amortizes per-call "
@@ -99,6 +105,13 @@ def _build_parser() -> argparse.ArgumentParser:
                         "hundred steps; EPE demonstrably drops from random "
                         "init, curve streamed to metrics.jsonl")
     p.add_argument("--num-steps", type=int, default=None)
+    p.add_argument("--ckpt-every", type=int, default=None, metavar="N",
+                   help="train mode: checkpoint period in steps (default: "
+                        "the stage preset's; shorten for failure-recovery "
+                        "drills — multi-host training resumes from the "
+                        "latest checkpoint after a process failure)")
+    p.add_argument("--log-every", type=int, default=None, metavar="N",
+                   help="train mode: metrics.jsonl/console logging period")
     p.add_argument("--train-size", type=int, nargs=2, default=None,
                    metavar=("H", "W"),
                    help="training crop size (default: the stage preset's "

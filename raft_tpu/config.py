@@ -136,6 +136,13 @@ class TrainConfig:
     adamw_eps: float = 1e-8
     clip_norm: float = 1.0
     gamma: float = 0.8           # sequence-loss decay (RAFT paper eq. 7)
+    # Sequence-loss denominator: 'total' = official RAFT's element-count
+    # mean ((valid * i_loss).mean() — invalid pixels still count in the
+    # denominator, so sparse-valid stages like the kitti finetune keep the
+    # official effective LR); 'valid' = per-valid-pixel mean (2-4x larger
+    # on KITTI-like ~25-50%-valid masks; compensate lr if selected).  See
+    # training/loss.py:sequence_loss.
+    loss_normalization: str = "total"
     optimizer: str = "adamw"     # adam | adamw | sgd | sgd_cyclic | sgd_1cycle
     schedule: str = "one_cycle"  # one_cycle | constant | cyclic
     pct_start: float = 0.05

@@ -61,6 +61,13 @@ class FlowDataset:
     def __len__(self) -> int:
         return len(self.image_list)
 
+    @property
+    def has_gt(self) -> bool:
+        """False for ground-truth-less splits (e.g. KITTI 'testing'):
+        __getitem__ then serves zero flow with an all-zero valid mask, and
+        the eval harness switches to pure prediction export."""
+        return bool(self.flow_list)
+
     def _read_flow(self, idx) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         path = self.flow_list[idx]
         if self.sparse:
@@ -76,6 +83,10 @@ class FlowDataset:
         by procedurally-generated datasets (synthetic.py)."""
         im1 = _read_image(self.image_list[idx][0])
         im2 = _read_image(self.image_list[idx][1])
+        if not self.flow_list:   # gt-less split (KITTI testing): all-invalid
+            h, w = im1.shape[:2]
+            return (im1, im2, np.zeros((h, w, 2), np.float32),
+                    np.zeros((h, w), np.float32))
         flow, valid = self._read_flow(idx)
         return im1, im2, flow, valid
 
@@ -215,6 +226,12 @@ class Kitti(FlowDataset):
         self.image_list = list(zip(images1, images2))
         if split == "training":
             self.flow_list = sorted(glob(osp.join(root, split, "flow_occ", "*_10.png")))
+
+    def dump_name(self, idx) -> str:
+        """Prediction filename for submission export: the first frame's
+        basename — exactly the devkit's ``<frame>_10.png`` scheme the KITTI
+        evaluation server requires (unique across the split)."""
+        return osp.basename(self.image_list[idx][0])
 
 
 class PairList:

@@ -47,6 +47,12 @@ _SENTINEL = None
 
 
 def _worker_loop(dataset, tasks, results):
+    # cold-start beacon: spawn + dataset unpickling can take seconds, and
+    # the first sample additionally pays the first heavy decode — without a
+    # readiness signal all of that counts against the consumer's FIRST
+    # stall window, false-positiving short stall_timeouts (ADVICE r3).
+    # The consumer treats this as progress, not a sample.
+    results.put(("ready", None))
     while True:
         task = tasks.get()
         if task is _SENTINEL:
@@ -149,6 +155,10 @@ class MPSampleLoader:
                         raise RuntimeError(
                             f"data workers alive but produced nothing for "
                             f"{stalled:.0f}s — likely {hint}") from None
+            if status == "ready":
+                # worker finished cold start (the queue get above already
+                # reset the stall clock); nothing to serve yet
+                continue
             if status == "error":
                 self.close()
                 raise RuntimeError(f"data worker failed:\n{payload}")

@@ -66,6 +66,13 @@ class SyntheticFlowDataset(FlowDataset):
     def __len__(self) -> int:
         return self.length
 
+    @property
+    def has_gt(self) -> bool:
+        # ground truth is generated procedurally — flow_list stays empty but
+        # every sample carries exact flow (the base-class file-list heuristic
+        # would wrongly report a gt-less split here)
+        return True
+
     def _load(self, idx):
         import cv2
         rng = np.random.RandomState((self.seed * 1_000_003 + idx) % (2**31))

@@ -5,6 +5,23 @@ The TPU-native replacement for the reference's absent comm backend
 a global mesh spanning all hosts' devices, and per-host batch slicing so
 each process feeds only its local shard (host data loading over DCN, compute
 collectives over ICI).
+
+Failure semantics — FAIL FAST, then resume from checkpoint:
+
+``jax.distributed`` is NOT elastic: the process set is fixed at
+``initialize`` and a member cannot be replaced mid-run.  When one process
+dies, the coordination service's heartbeat detection (peers missed for
+``heartbeat_timeout_seconds``, default 100 — RAFT_TPU_HEARTBEAT_TIMEOUT
+overrides) declares the job failed and ABORTS every surviving process,
+including ones blocked inside a cross-host collective.  That is the
+designed behavior: a surviving process cannot make progress anyway (every
+train step psums gradients across all hosts), so the only wrong outcome
+would be an indefinite hang.  Recovery is operational, not in-process:
+relaunch ALL processes with the same ``--out`` — the trainer resumes from
+the latest complete checkpoint (atomic writes by process 0; the
+consistent-resume guard in training/loop.py verifies every process
+restored the same step before touching the mesh).  Pinned by
+tests/test_distributed.py::test_two_process_failure_fail_fast_and_resume.
 """
 
 from __future__ import annotations
@@ -34,9 +51,16 @@ def initialize(coordinator_address: Optional[str] = None,
         coordinator_address = os.environ.get("RAFT_TPU_COORDINATOR")
     if process_id is None and "RAFT_TPU_PROCESS_ID" in os.environ:
         process_id = int(os.environ["RAFT_TPU_PROCESS_ID"])
+    kwargs = {}
+    if "RAFT_TPU_HEARTBEAT_TIMEOUT" in os.environ:
+        # how long peers may go unheard-from before the job fails fast (see
+        # module docstring); the jax default of 100s is right for production
+        # — tests shrink it so failure drills finish in seconds
+        kwargs["heartbeat_timeout_seconds"] = int(
+            os.environ["RAFT_TPU_HEARTBEAT_TIMEOUT"])
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
-                               process_id=process_id)
+                               process_id=process_id, **kwargs)
 
 
 def process_info() -> Tuple[int, int]:

@@ -16,6 +16,7 @@ datasets like Sintel; evaluate_cli opts into 64 for KITTI only.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Dict, Optional
 
@@ -27,6 +28,21 @@ from ..config import RAFTConfig
 from ..data.pipeline import pad_to_multiple, unpad
 from .loss import epe_metrics
 from .step import make_eval_step
+
+
+def _gt_canvas(flow_gt: np.ndarray, valid: np.ndarray, pads, hw):
+    """Place unpadded ground truth into the padded prediction's canvas with
+    valid=0 in the padding, so metrics can run batched on the PADDED shape:
+    inside the valid region the padded prediction is bit-identical to its
+    unpadded slice, and the zero-valid border contributes nothing."""
+    t, _, l, _ = pads
+    H, W = hw
+    h, w = flow_gt.shape[:2]
+    g = np.zeros((H, W, 2), np.float32)
+    v = np.zeros((H, W), np.float32)
+    g[t:t + h, l:l + w] = flow_gt
+    v[t:t + h, l:l + w] = valid
+    return g, v
 
 
 def evaluate_dataset(params, config: RAFTConfig, dataset,
@@ -57,13 +73,14 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
     eval resolutions on TPU).  A shape group's remainder runs at its natural
     size: at most one extra compile per distinct padded shape.
 
-    ``dump_dir``: also write each unpadded prediction, named
-    ``frame_<idx:06d>`` in dataset order — KITTI 16-bit flow PNG encoding
-    for ``pad_mode="kitti"``, ``.flo`` otherwise.  This is the prediction-
-    export half of the official repo's create_*_submission tools; an actual
-    KITTI server upload additionally needs the devkit's ``<frame>_10.png``
-    naming and the testing split (this harness evaluates the training
-    split, which has ground truth).
+    ``dump_dir``: also write each unpadded prediction — KITTI 16-bit flow
+    PNG encoding for ``pad_mode="kitti"``, ``.flo`` otherwise.  Files are
+    named by the dataset's ``dump_name(idx)`` when it provides one (KITTI:
+    the devkit's ``<frame>_10.png`` scheme the evaluation server requires),
+    else ``frame_<idx:06d>`` in dataset order.  With a ground-truth-less
+    dataset (``has_gt == False``, e.g. the KITTI testing split) metrics are
+    skipped and this becomes a pure submission export — the official repo's
+    create_kitti_submission equivalent.
     """
     assert bucket % 8 == 0 and bucket > 0, bucket
     assert batch_size >= 1, batch_size
@@ -71,11 +88,22 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
         raise ValueError(f"weighting must be 'sample' or 'pixel', "
                          f"got {weighting!r}")
     eval_fn = jax.jit(make_eval_step(config, iters=iters))
+    # Batched, jitted metric reduction: per-sample valid-masked SUMS (vmap of
+    # the same epe_metrics the per-sample path used), so a flush group costs
+    # ONE device call and ONE device_get regardless of batch size — no
+    # per-sample dispatch/transfer round-trips (the overhead --eval-batch
+    # exists to amortize).
+    metric_fn = jax.jit(jax.vmap(functools.partial(epe_metrics, reduce="sum")))
+    has_gt = getattr(dataset, "has_gt", True)
     sums: Dict[str, float] = {}
     count = 0
     shapes_seen = set()
     t0 = time.time()
     n = len(dataset) if max_samples is None else min(max_samples, len(dataset))
+    if not has_gt and dump_dir is None:
+        raise ValueError(
+            "dataset has no ground truth (e.g. the KITTI testing split): "
+            "pass dump_dir (--dump-flow) to export predictions instead")
 
     if dump_dir is not None:
         from pathlib import Path
@@ -96,27 +124,45 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
         # batching, a shape group costs one compile per distinct flush size
         # (full batches + at most one remainder)
         shapes_seen.add((len(group),) + group[0][0].shape[1:])
-        flows = np.asarray(eval_fn(
+        flows_dev = eval_fn(
             params, jnp.asarray(np.concatenate([g[0] for g in group])),
-            jnp.asarray(np.concatenate([g[1] for g in group]))))
-        for (im1p, _, pads, flow_gt, valid, idx), flow in zip(group, flows):
-            fl = unpad(flow[None], pads)[0]
-            if dump_dir is not None:
+            jnp.asarray(np.concatenate([g[1] for g in group])))
+        if has_gt:
+            hw = group[0][0].shape[1:3]
+            canv = [_gt_canvas(g[3], g[4], g[2], hw) for g in group]
+            msums = jax.device_get(metric_fn(
+                flows_dev,
+                jnp.asarray(np.stack([c[0] for c in canv])),
+                jnp.asarray(np.stack([c[1] for c in canv]))))
+            vp = msums.pop("valid_px")                        # [B], raw
+            if weighting == "pixel":
+                # pool the TRUE count: a zero-valid sample must contribute
+                # nothing to the pooled denominator (clamping belongs only
+                # to the per-image division below)
+                sums["valid_px"] = sums.get("valid_px", 0.0) + float(vp.sum())
+            for k, arr in msums.items():
+                inc = arr.sum() if weighting == "pixel" \
+                    else (arr / np.maximum(vp, 1.0)).sum()    # per-image means
+                sums[k] = sums.get(k, 0.0) + float(inc)
+        if dump_dir is not None:
+            flows = np.asarray(flows_dev)
+            for (_, _, pads, _, _, idx), flow in zip(group, flows):
+                fl = unpad(flow[None], pads)[0]
+                name = (dataset.dump_name(idx)
+                        if hasattr(dataset, "dump_name") else None)
                 if pad_mode == "kitti":     # the KITTI server's 16-bit PNG
                     write_kitti_flow(fl, Path(dump_dir) /
-                                     f"frame_{idx:06d}.png")
+                                     (name or f"frame_{idx:06d}.png"))
                 else:
-                    write_flo(fl, Path(dump_dir) / f"frame_{idx:06d}.flo")
-            m = jax.device_get(epe_metrics(
-                jnp.asarray(fl), jnp.asarray(flow_gt), jnp.asarray(valid),
-                reduce="sum" if weighting == "pixel" else "mean"))
-            for k, v in m.items():
-                sums[k] = sums.get(k, 0.0) + float(v)
-            count += 1
-            if verbose and count % 50 == 0:
-                running = (sums["epe"] / max(sums.get("valid_px", 1.0), 1.0)
-                           if weighting == "pixel" else sums["epe"] / count)
-                print(f"  eval {count}/{n}  epe so far {running:.3f}")
+                    write_flo(fl, Path(dump_dir) / (
+                        name.rsplit(".", 1)[0] + ".flo" if name
+                        else f"frame_{idx:06d}.flo"))
+        prev = count
+        count += len(group)
+        if verbose and has_gt and count // 50 > prev // 50:
+            running = (sums["epe"] / max(sums.get("valid_px", 1.0), 1.0)
+                       if weighting == "pixel" else sums["epe"] / count)
+            print(f"  eval {count}/{n}  epe so far {running:.3f}")
 
     groups: Dict[tuple, list] = {}
     for idx in range(n):
@@ -161,6 +207,14 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
         # a zero/negative cap would 'succeed' with samples=0 — fail instead
         print(f"ERROR: --max-samples must be >= 1, got {args.max_samples}")
         return 2
+    if getattr(args, "split", None) == "testing":
+        if args.dataset != "kitti":
+            print("ERROR: --split testing is only wired for --dataset kitti")
+            return 2
+        if not getattr(args, "dump_flow", None):
+            print("ERROR: the KITTI testing split has no ground truth — "
+                  "pass --dump-flow DIR to export a server submission")
+            return 2
     params = load_params(args, config)
     bucket = 8
     if args.dataset == "synthetic":
@@ -184,11 +238,26 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
         ds = D.FlyingThings3D(args.data)
         pad_mode = "sintel"
     elif args.dataset == "kitti":
-        ds = D.Kitti(args.data, "training")
+        ds = D.Kitti(args.data, getattr(args, "split", None) or "training")
         pad_mode = "kitti"
         bucket = 64          # per-image sizes: bucket onto one compile
     else:
         print(f"ERROR: no val handler for dataset {args.dataset!r}")
+        return 2
+    if len(ds) == 0:
+        # an empty scan must not 'succeed' (same contract as the
+        # --max-samples<=0 guard): a wrong --data root exporting an empty
+        # submission directory with exit 0 would be silent data loss
+        print(f"ERROR: dataset {args.dataset!r} found 0 samples under "
+              f"{args.data!r} — check --data (and --split)")
+        return 2
+    if not getattr(ds, "has_gt", True) and not getattr(args, "dump_flow", None):
+        # also reachable with --split training when the root has images but
+        # no flow_occ ground truth — print the CLI-contract error, not the
+        # library ValueError traceback
+        print("ERROR: dataset has no ground-truth flow (testing split, or "
+              "a root missing flow_occ/) — metrics are impossible; pass "
+              "--dump-flow DIR to export predictions instead")
         return 2
     if getattr(args, "bucket", None) is not None:
         bucket = args.bucket
@@ -203,6 +272,11 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
                                dump_dir=getattr(args, "dump_flow", None),
                                max_samples=getattr(args, "max_samples", None))
     name = f"{args.dataset} ({'small' if args.small else 'full'})"
+    if not getattr(ds, "has_gt", True):
+        print(f"[val] {name}: no ground truth — exported "
+              f"{metrics['samples']} prediction(s) to {args.dump_flow} "
+              f"(devkit naming) in {metrics['seconds']:.1f}s")
+        return 0
     print(f"[val] {name}: " + "  ".join(
         f"{k}={v:.4f}" for k, v in metrics.items()))
     return 0

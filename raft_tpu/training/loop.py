@@ -272,6 +272,16 @@ def train_cli(args, config: RAFTConfig) -> int:
         overrides["accum_steps"] = args.accum
     if getattr(args, "train_size", None):
         overrides["image_size"] = tuple(args.train_size)
+    for flag in ("ckpt_every", "log_every"):
+        val = getattr(args, flag, None)
+        if val is not None:
+            if val < 1:
+                # validate before the slow compile: a zero period would
+                # ZeroDivisionError at the first `step % period` check
+                print(f"ERROR: --{flag.replace('_', '-')} must be >= 1, "
+                      f"got {val}")
+                return 2
+            overrides[flag] = val
     tconfig = TrainConfig.for_stage(args.dataset, **overrides)
 
     # stage warm start (official curriculum: each stage --load's the previous

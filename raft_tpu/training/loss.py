@@ -16,23 +16,46 @@ import jax.numpy as jnp
 
 def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array,
                   valid: Optional[jax.Array] = None, gamma: float = 0.8,
-                  max_flow: float = 400.0) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """L_seq = sum_i gamma^(N-i-1) * mean_valid |pred_i - gt|_1.
+                  max_flow: float = 400.0,
+                  normalization: str = "total") -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """L_seq = sum_i gamma^(N-i-1) * mean |pred_i - gt|_1 over valid pixels.
 
     flow_preds: [iters, B, H, W, 2] upsampled per-iteration predictions.
     flow_gt: [B, H, W, 2]; valid: [B, H, W] bool/0-1 mask (None = all valid).
-    Returns (scalar loss, metrics dict with epe / 1px / 3px / 5px on the
-    final prediction).
+
+    ``normalization`` picks the loss denominator:
+
+    - ``"total"`` (default): divide by the TOTAL pixel count B*H*W — the
+      official RAFT recipe's ``(valid[:, None] * i_loss).mean()``, where
+      invalid pixels contribute zero to the numerator but still count in
+      the denominator.  On sparse-valid data (KITTI: ~25-50% valid) this
+      keeps the effective loss scale — and therefore the effective learning
+      rate of the official finetune presets — identical to the official
+      implementation (pinned by the torch-autograd oracle in
+      tests/test_torch_golden.py).
+    - ``"valid"``: divide by the valid-pixel count, so the loss is a true
+      per-valid-pixel mean, invariant to the valid fraction.  2-4x larger
+      than "total" on KITTI-like masks; use only with an LR compensated
+      accordingly.
+
+    The two are identical when every pixel is valid.  Metrics (epe / Npx)
+    are always valid-pixel means, matching the official evaluation.
+    Returns (scalar loss, metrics dict on the final prediction).
     """
+    if normalization not in ("total", "valid"):
+        raise ValueError(f"normalization must be 'total' or 'valid', "
+                         f"got {normalization!r}")
     n = flow_preds.shape[0]
     mag = jnp.linalg.norm(flow_gt, axis=-1)
-    v = jnp.ones_like(mag) if valid is None else valid.astype(jnp.float32)
+    v = jnp.ones_like(mag) if valid is None \
+        else (valid.astype(jnp.float32) >= 0.5).astype(jnp.float32)
     v = v * (mag < max_flow)
     denom = jnp.maximum(v.sum(), 1.0)
+    loss_denom = jnp.float32(mag.size) if normalization == "total" else denom
 
     weights = gamma ** jnp.arange(n - 1, -1, -1, dtype=jnp.float32)  # [n]
     l1 = jnp.abs(flow_preds - flow_gt[None]).mean(axis=-1)           # [n,B,H,W]
-    per_iter = (l1 * v[None]).sum(axis=(1, 2, 3)) / denom            # [n]
+    per_iter = (l1 * v[None]).sum(axis=(1, 2, 3)) / loss_denom       # [n]
     loss = (weights * per_iter).sum()
 
     epe = jnp.linalg.norm(flow_preds[-1] - flow_gt, axis=-1)         # [B,H,W]

@@ -49,9 +49,10 @@ def make_train_step(config: RAFTConfig, tconfig: TrainConfig,
             out, new_params = raft_forward(
                 params, batch.image1, batch.image2, config, train=True,
                 axis_name=axis_name, rng=rng)
-            loss, metrics = sequence_loss(out.flow_iters, batch.flow,
-                                          batch.valid, gamma=tconfig.gamma,
-                                          max_flow=tconfig.max_flow)
+            loss, metrics = sequence_loss(
+                out.flow_iters, batch.flow, batch.valid,
+                gamma=tconfig.gamma, max_flow=tconfig.max_flow,
+                normalization=tconfig.loss_normalization)
             _, new_bn = split_bn_state(new_params)
             return loss, (new_bn, metrics)
 

@@ -279,3 +279,14 @@ def test_native_decode_routing_by_bit_depth(tmp_path):
     got = _read_image(p)
     want = cv2.imdecode(np.frombuffer(bytes(png16), np.uint8), cv2.IMREAD_COLOR)
     np.testing.assert_array_equal(got, want)
+
+
+def test_synthetic_dataset_reports_ground_truth():
+    """Procedural datasets carry exact gt despite an empty flow_list — the
+    base-class file-list heuristic must not classify them as gt-less (that
+    would make `-m val --dataset synthetic` refuse to evaluate)."""
+    from raft_tpu.data.synthetic import SyntheticFlowDataset
+    ds = SyntheticFlowDataset(size=(16, 24), length=2)
+    assert ds.has_gt
+    im1, im2, flow, valid = ds[0]
+    assert flow.shape == (16, 24, 2) and valid.all()

@@ -314,3 +314,84 @@ def test_two_process_train_cli_matches_single_process(tmp_path):
             (a, b)
         assert abs(a["epe"] - b["epe"]) <= 1e-3 * max(1.0, abs(b["epe"])), \
             (a, b)
+
+
+def test_two_process_failure_fail_fast_and_resume(tmp_path):
+    """Multi-host failure drill (jax.distributed is NOT elastic): kill one
+    of two coordinated training processes mid-run and the survivor must
+    ABORT promptly (heartbeat detection — the wrong outcome is an
+    indefinite hang in the next cross-host psum), then relaunching BOTH
+    processes with the same --out must resume from the latest complete
+    checkpoint and finish.  See raft_tpu/parallel/distributed.py module
+    docstring for the contract under test."""
+    import glob
+    import socket
+    import time as _time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "mh"
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["RAFT_TPU_HEARTBEAT_TIMEOUT"] = "10"   # seconds, not the 100s prod default
+
+    def launch(port, num_steps):
+        return [subprocess.Popen(
+            [sys.executable, "-m", "raft_tpu.cli", "-m", "train", "--cpu",
+             "--dataset", "synthetic", "--small", "--iters", "2",
+             "--num-steps", str(num_steps), "--batch", "4",
+             "--train-size", "32", "48", "--ckpt-every", "3",
+             "--log-every", "1", "--shard-data", "--out", str(out),
+             "--coordinator", f"localhost:{port}",
+             "--num-processes", "2", "--process-id", str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=repo) for pid in range(2)]
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+    procs = launch(port, 100_000)   # far more steps than we will allow
+    try:
+        # wait for training to be genuinely underway (a periodic checkpoint
+        # exists), then kill the non-coordinator process
+        deadline = _time.time() + 600
+        ckpts = []
+        while _time.time() < deadline and not ckpts:
+            ckpts = glob.glob(str(out / "checkpoints" / "ckpt_*.npz"))
+            if procs[0].poll() is not None:   # died early: surface its log
+                raise AssertionError(procs[0].communicate()[0])
+            _time.sleep(2)
+        assert ckpts, "no checkpoint appeared within 600s"
+        procs[1].kill()
+        # fail fast: the survivor must exit NONZERO well within the test
+        # budget (heartbeat timeout 10s + abort), not hang forever
+        out0, _ = procs[0].communicate(timeout=300)
+        assert procs[0].returncode != 0, \
+            f"survivor exited 0 despite peer death:\n{out0}"
+    finally:
+        for p in procs:
+            p.kill()
+
+    steps = sorted(int(p.rsplit("_", 1)[1].split(".")[0])
+                   for p in glob.glob(str(out / "checkpoints" / "ckpt_*.npz")))
+    restored = steps[-1]
+
+    # recovery recipe: relaunch ALL processes, same --out -> resume + finish
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+    procs = launch(port, restored + 4)
+    outs = []
+    try:
+        for p in procs:
+            o, _ = p.communicate(timeout=900)
+            outs.append(o)
+    finally:
+        for p in procs:
+            p.kill()
+    for pid, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"relaunched worker {pid} failed:\n{o}"
+    assert any(f"resumed from" in o and f"at step {restored}" in o
+               for o in outs), outs[0][-2000:]
+    recs = _read_metrics(out / "checkpoints" / "metrics.jsonl")
+    assert recs[-1]["step"] == restored + 3 and np.isfinite(recs[-1]["loss"])

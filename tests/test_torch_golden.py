@@ -246,3 +246,57 @@ def test_official_state_dict_shape_contract():
     params = from_torch_state_dict(sd)
     expected = init_raft(jax.random.PRNGKey(0), RAFTConfig.full())
     assert_tree_shapes_match(params, expected)
+
+
+def test_sequence_loss_torch_oracle_sparse_valid():
+    """Pin the sequence-loss NORMALIZATION against the official recipe with
+    torch autograd, on a ~30%-valid batch (the KITTI finetune regime where
+    the denominator choice matters most: a valid-count mean would be ~3x the
+    official element-count mean, silently inflating the effective LR).
+
+    The torch restatement below is the official repo's sequence_loss
+    semantics verbatim-in-spirit: ``(valid[:, None] * i_loss).mean()`` over
+    ALL elements.  Both the loss VALUE and d(loss)/d(flow_preds) — the
+    gradient a training step backpropagates into the model — must match.
+    """
+    n, B, H, W = 3, 2, 16, 24
+    rng = np.random.RandomState(11)
+    preds = rng.randn(n, B, H, W, 2).astype(np.float32) * 3
+    gt = rng.randn(B, H, W, 2).astype(np.float32) * 3
+    gt[0, :4, :4] = 900.0                      # beyond max_flow: masked out
+    valid = (rng.rand(B, H, W) < 0.3).astype(np.float32)
+    gamma, max_flow = 0.85, 400.0
+
+    # torch oracle (official train.py semantics, NCHW)
+    tpreds = torch.tensor(preds.transpose(0, 1, 4, 2, 3), requires_grad=True)
+    tgt = torch.tensor(gt.transpose(0, 3, 1, 2))
+    tvalid = torch.tensor(valid)
+    mag = torch.sum(tgt ** 2, dim=1).sqrt()
+    tv = (tvalid >= 0.5) & (mag < max_flow)
+    tloss = 0.0
+    for i in range(n):
+        i_loss = (tpreds[i] - tgt).abs()
+        tloss = tloss + gamma ** (n - i - 1) * (tv[:, None] * i_loss).mean()
+    tloss.backward()
+    tgrad = tpreds.grad.numpy().transpose(0, 1, 3, 4, 2)   # -> [n,B,H,W,2]
+
+    from raft_tpu.training import sequence_loss
+
+    def loss_fn(p):
+        loss, _ = sequence_loss(p, jnp.asarray(gt), jnp.asarray(valid),
+                                gamma=gamma, max_flow=max_flow)
+        return loss
+
+    jloss, jgrad = jax.value_and_grad(loss_fn)(jnp.asarray(preds))
+    np.testing.assert_allclose(float(jloss), float(tloss.detach()), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(jgrad), tgrad, atol=1e-7)
+
+    # epe metric stays a VALID-pixel mean (official evaluation convention:
+    # epe.view(-1)[valid.view(-1)].mean())
+    _, metrics = sequence_loss(jnp.asarray(preds), jnp.asarray(gt),
+                               jnp.asarray(valid), gamma=gamma,
+                               max_flow=max_flow)
+    tepe = torch.sum((tpreds[-1].detach() - tgt) ** 2, dim=1).sqrt()
+    tepe_mean = tepe.reshape(-1)[tv.reshape(-1)].mean()
+    np.testing.assert_allclose(float(metrics["epe"]), float(tepe_mean),
+                               rtol=1e-5)

@@ -35,6 +35,9 @@ def main() -> int:
     p.add_argument("--accum", type=int, default=1,
                    help="gradient-accumulation micro-steps (measures the "
                         "memory-for-time trade of TrainConfig.accum_steps)")
+    p.add_argument("--unroll", type=int, default=None,
+                   help="override RAFTConfig.scan_unroll for the GRU "
+                        "iteration loop (A/B the unroll default)")
     p.add_argument("--quick", action="store_true",
                    help="tiny shapes for CI smoke (64x96, batch 2, 3 iters)")
     p.add_argument("--cpu", action="store_true")
@@ -56,7 +59,16 @@ def main() -> int:
     dev = jax.devices()[0]
     impl = args.impl
     if jax.default_backend() != "tpu" and impl.startswith("pallas"):
-        impl = "blockwise"     # interpret mode would swamp the timing
+        # interpret mode would swamp the timing — fall back to blockwise,
+        # but KEEP the composable non-pallas tokens (e.g. -ctx) so a CPU
+        # run of 'pallas-bf16corr-ctx' still measures gru_ctx_hoist rather
+        # than silently timing the plain config (kernel-only tokens like
+        # -win/-pack/bf16corr have no blockwise meaning and are dropped;
+        # use --precision to override corr precision explicitly).
+        kept = [t for t in impl.split("-")[1:] if t in ("ctx", "onehot")]
+        impl = "-".join(["blockwise"] + kept)
+        print(f"# non-TPU backend: measuring {impl!r} instead of "
+              f"{args.impl!r}", file=sys.stderr)
     H, W = args.size
     # candidate names share bench.py's mapping (-win/-pack/-winpack etc.);
     # explicit --precision and the training iteration count then override
@@ -67,6 +79,8 @@ def main() -> int:
                                  compute_dtype="bfloat16")
     if args.precision is not None:
         config = dataclasses.replace(config, corr_precision=args.precision)
+    if args.unroll is not None:
+        config = dataclasses.replace(config, scan_unroll=args.unroll)
     tconfig = TrainConfig(num_steps=1000, batch_size=args.batch,
                           image_size=(H, W), accum_steps=args.accum)
     tx = make_optimizer(tconfig)
@@ -94,7 +108,9 @@ def main() -> int:
     print(json.dumps({
         "metric": f"raft-things train-step throughput @ {args.iters} iters, "
                   f"{args.batch}x{H}x{W} ({impl}, {config.corr_precision}"
-                  + (f", accum {args.accum}" if args.accum > 1 else "") + ")",
+                  + (f", accum {args.accum}" if args.accum > 1 else "")
+                  + (f", unroll {config.scan_unroll}"
+                     if config.scan_unroll != 1 else "") + ")",
         "device": dev.device_kind,
         "value": round(args.batch / dt, 4),
         "unit": "pairs/sec/chip",

@@ -5,19 +5,22 @@
 set -u
 cd "$(dirname "$0")/.."
 OUT=$1; shift
-MARKER=artifacts/hw_r3/.queue_started
+# gate on the queue's LIVE flock (held for the queue's whole run), not on a
+# persistent marker: a marker file would outlive the run and insta-kill any
+# training launched between hardware windows
+QLOCK=artifacts/hw_r4/.queue_lock
 mkdir -p "$OUT"
 nice -n 19 python -m raft_tpu.cli -m train "$@" --out "$OUT" \
   >> "$OUT/train.log" 2>&1 &
 PID=$!
 echo "train pid $PID" >> "$OUT/train.log"
 while kill -0 "$PID" 2>/dev/null; do
-  if [ -e "$MARKER" ]; then
-    echo "hw queue started; stopping background training" >> "$OUT/train.log"
+  if [ -e "$QLOCK" ] && ! flock -n "$QLOCK" true; then
+    echo "hw queue running; stopping background training" >> "$OUT/train.log"
     kill -TERM "$PID"
     break
   fi
-  sleep 60
+  sleep 5
 done
 wait "$PID" 2>/dev/null
 echo "train exited rc=$? $(date -u +%H:%M:%SZ)" >> "$OUT/train.log"

@@ -1,8 +1,8 @@
 #!/bin/bash
 # Unattended hardware-validation queue (VERDICT round-2 item 1).
 #
-# Runs the full round-3 capture in the mandated order the moment the TPU
-# data plane is back, logging everything under artifacts/hw_r3/.  Each
+# Runs the full capture in the mandated order the moment the TPU
+# data plane is back, logging everything under artifacts/hw_r4/.  Each
 # stage gets its own timeout so one hang cannot eat the tunnel window;
 # stages are independent (a failed sweep still lets bench.py run).
 #
@@ -13,11 +13,23 @@
 # retires once .queue_done appears.
 set -u
 cd "$(dirname "$0")/.."
-OUT=artifacts/hw_r3
+OUT=artifacts/hw_r4
 mkdir -p "$OUT"
 exec 9>"$OUT/.queue_lock"
 flock -n 9 || { echo "hw_queue already running"; exit 0; }
 [ -e "$OUT/.queue_done" ] && { echo "hw_queue already complete"; exit 0; }
+# Background-training standdown: watchers (bg_train_watch.sh) gate on this
+# queue's live flock (held for the whole run; .queue_started is a transient
+# observability breadcrumb, removed on exit).  WAIT for any training
+# process to actually exit (the watcher polls every 5 s) so stage-1 timings
+# never overlap nice-19 CPU work; proceed after 90 s regardless rather than
+# lose the window.
+touch "$OUT/.queue_started"
+trap 'rm -f "$OUT/.queue_started"' EXIT
+for _ in $(seq 90); do
+  pgrep -f "raft_tpu.cli.*-m train" > /dev/null 2>&1 || break
+  sleep 1
+done
 
 all_ok=1
 run() {  # run <name> <timeout_s> <cmd...>
