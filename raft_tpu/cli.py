@@ -48,10 +48,11 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--corr-impl", default="dense",
                    choices=["dense", "blockwise", "pallas"])
     p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
-    p.add_argument("--ctx-hoist", action="store_true",
+    p.add_argument("--ctx-hoist", action=argparse.BooleanOptionalAction,
+                   default=None,
                    help="precompute the GRU gate convs' context terms outside "
-                        "the iteration loop (exact rewrite; measured perf "
-                        "knob — see TUNING.md)")
+                        "the iteration loop (exact rewrite; default ON from "
+                        "measured A/Bs — --no-ctx-hoist disables; TUNING.md)")
     p.add_argument("--rgb", action="store_true",
                    help="input is RGB (default BGR, matching the reference)")
     p.add_argument("--save-flo", action="store_true", help="also write .flo")
@@ -87,10 +88,15 @@ def _build_parser() -> argparse.ArgumentParser:
                         "submittable), .flo named frame_<idx:06d> otherwise")
     p.add_argument("--split", default=None,
                    choices=["training", "testing"],
-                   help="val mode, --dataset kitti: which split to run "
-                        "(default training; 'testing' has no ground truth — "
-                        "metrics are skipped and --dump-flow is required, "
-                        "producing the KITTI server submission directory)")
+                   help="val mode, --dataset kitti/sintel: which split to "
+                        "run (default training; 'testing' has no ground "
+                        "truth — metrics are skipped and --dump-flow is "
+                        "required, producing a server-submission directory: "
+                        "devkit <frame>_10.png PNGs for kitti, "
+                        "<scene>/frame_XXXX.flo for sintel)")
+    p.add_argument("--dstype", default=None, choices=["clean", "final"],
+                   help="val mode, --dataset sintel: which render pass "
+                        "(default clean; submissions need both)")
     p.add_argument("--eval-batch", type=int, default=None, metavar="N",
                    help="val mode: samples per device call, grouped by "
                         "padded shape (identical metrics; amortizes per-call "
@@ -162,8 +168,9 @@ def _build_parser() -> argparse.ArgumentParser:
 
 def _make_config(args):
     from .config import RAFTConfig
-    overrides = dict(corr_impl=args.corr_impl, compute_dtype=args.dtype,
-                     gru_ctx_hoist=args.ctx_hoist)
+    overrides = dict(corr_impl=args.corr_impl, compute_dtype=args.dtype)
+    if args.ctx_hoist is not None:       # tri-state: None = config default
+        overrides["gru_ctx_hoist"] = args.ctx_hoist
     if args.iters is not None:
         overrides["iters"] = args.iters
     if args.small:

@@ -88,9 +88,13 @@ class RAFTConfig:
     # linear over input-channel blocks) that removes 1/3 of the gate-conv
     # FLOPs inside the loop (~26% for the small variant).  XLA does not do
     # this itself (loop-invariant code motion moves whole ops, not partial
-    # contractions).  Identical values (parity-tested); measured knob,
-    # default off until hardware numbers land (TUNING.md).
-    gru_ctx_hoist: bool = False
+    # contractions).  Identical values (forward + gradient torch-oracle
+    # parity tested).  Default ON from measured A/Bs on the compute-bound
+    # CPU backend: train step +17% (tools/bench_train.py, quiet-core
+    # round-4 sweep), inference +7.7% (round-3, PERF.md); a pure FLOP cut,
+    # so it can only help more where the gate convs dominate (round-2 TPU
+    # attribution).  TPU confirmation stage queued in tools/hw_queue.sh.
+    gru_ctx_hoist: bool = True
 
     @property
     def fnet_dim(self) -> int:

@@ -158,6 +158,7 @@ class MpiSintel(FlowDataset):
     def __init__(self, root, split: str = "training", dstype: str = "clean",
                  augmentor: Optional[FlowAugmentor] = None):
         super().__init__(augmentor)
+        self.dstype = dstype
         image_root = osp.join(root, split, dstype)
         flow_root = osp.join(root, split, "flow")
         for scene in sorted(glob(osp.join(image_root, "*"))):
@@ -170,6 +171,17 @@ class MpiSintel(FlowDataset):
         if split == "training":
             assert len(self.flow_list) == len(self.image_list), (
                 len(self.flow_list), len(self.image_list))
+
+    def dump_name(self, idx) -> str:
+        """Relative prediction path for submission export:
+        ``<dstype>/<scene>/frame_XXXX.png`` (the eval harness swaps the
+        extension to .flo) — the official create_sintel_submission layout.
+        The render-pass level matters: a submission needs BOTH clean and
+        final, and without it the two exports into one --dump-flow dir
+        would silently overwrite each other (identical scene/frame names)."""
+        a = self.image_list[idx][0]
+        return osp.join(self.dstype, osp.basename(osp.dirname(a)),
+                        osp.basename(a))
 
 
 class FlyingChairs(FlowDataset):

@@ -151,12 +151,15 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
                 name = (dataset.dump_name(idx)
                         if hasattr(dataset, "dump_name") else None)
                 if pad_mode == "kitti":     # the KITTI server's 16-bit PNG
-                    write_kitti_flow(fl, Path(dump_dir) /
-                                     (name or f"frame_{idx:06d}.png"))
+                    path = Path(dump_dir) / (name or f"frame_{idx:06d}.png")
                 else:
-                    write_flo(fl, Path(dump_dir) / (
+                    path = Path(dump_dir) / (
                         name.rsplit(".", 1)[0] + ".flo" if name
-                        else f"frame_{idx:06d}.flo"))
+                        else f"frame_{idx:06d}.flo")
+                # dump names may carry subdirectories (Sintel: scene/frame)
+                path.parent.mkdir(parents=True, exist_ok=True)
+                (write_kitti_flow if pad_mode == "kitti" else write_flo)(
+                    fl, path)
         prev = count
         count += len(group)
         if verbose and has_gt and count // 50 > prev // 50:
@@ -208,12 +211,14 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
         print(f"ERROR: --max-samples must be >= 1, got {args.max_samples}")
         return 2
     if getattr(args, "split", None) == "testing":
-        if args.dataset != "kitti":
-            print("ERROR: --split testing is only wired for --dataset kitti")
+        if args.dataset not in ("kitti", "sintel"):
+            print("ERROR: --split testing is only wired for --dataset "
+                  "kitti / sintel")
             return 2
         if not getattr(args, "dump_flow", None):
-            print("ERROR: the KITTI testing split has no ground truth — "
-                  "pass --dump-flow DIR to export a server submission")
+            print(f"ERROR: the {args.dataset} testing split has no ground "
+                  "truth — pass --dump-flow DIR to export a server "
+                  "submission")
             return 2
     params = load_params(args, config)
     bucket = 8
@@ -229,7 +234,12 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
         print("ERROR: --data <dataset root> is required for val mode")
         return 2
     elif args.dataset == "sintel":
-        ds = D.MpiSintel(args.data, "training", "clean")
+        # Sintel's gt-less split directory is named 'test'; submissions
+        # cover both renders ('clean'/'final' via --dstype)
+        split = ("test" if getattr(args, "split", None) == "testing"
+                 else "training")
+        ds = D.MpiSintel(args.data, split,
+                         getattr(args, "dstype", None) or "clean")
         pad_mode = "sintel"
     elif args.dataset == "chairs":
         ds = D.FlyingChairs(args.data, "validation")
@@ -275,7 +285,7 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
     if not getattr(ds, "has_gt", True):
         print(f"[val] {name}: no ground truth — exported "
               f"{metrics['samples']} prediction(s) to {args.dump_flow} "
-              f"(devkit naming) in {metrics['seconds']:.1f}s")
+              f"(server-submission naming) in {metrics['seconds']:.1f}s")
         return 0
     print(f"[val] {name}: " + "  ".join(
         f"{k}={v:.4f}" for k, v in metrics.items()))

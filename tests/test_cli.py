@@ -29,7 +29,7 @@ def test_mode_test_ctx_hoist_matches_plain(tmp_path, capsys):
     import numpy as np
     a_dir, b_dir = tmp_path / "a", tmp_path / "b"
     common = ["-m", "test", "--small", "--iters", "2", "--size", "48", "64"]
-    assert cli.main(common + ["--out", str(a_dir)]) == 0
+    assert cli.main(common + ["--no-ctx-hoist", "--out", str(a_dir)]) == 0
     assert cli.main(common + ["--ctx-hoist", "--out", str(b_dir)]) == 0
     a = cv2.imread(str(a_dir / "raft_flow_raft-small.png")).astype(np.int16)
     b = cv2.imread(str(b_dir / "raft_flow_raft-small.png")).astype(np.int16)

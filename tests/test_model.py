@@ -150,7 +150,9 @@ def test_gru_ctx_hoist_equivalence(small):
     """gru_ctx_hoist is an exact rewrite (conv linearity over input-channel
     blocks): forward outputs must match the plain path, both variants."""
     mk = RAFTConfig.small_model if small else RAFTConfig.full
-    base = mk(iters=3, corr_levels=2)
+    # explicit False: the config DEFAULT is now hoisted, so an inherited
+    # default would compare hoisted-vs-hoisted and prove nothing
+    base = mk(iters=3, corr_levels=2, gru_ctx_hoist=False)
     hoisted = mk(iters=3, corr_levels=2, gru_ctx_hoist=True)
     params, im1, im2 = _params_and_images(base, H=32, W=48)
     out_a, _ = raft_forward(params, im1, im2, base, train=True)
@@ -165,7 +167,8 @@ def test_gru_ctx_hoist_equivalence(small):
 def test_gru_ctx_hoist_gradient_equivalence():
     """The hoisted path must also produce the same parameter gradients (the
     kernel slices recombine in the cotangent)."""
-    base = RAFTConfig.small_model(iters=2, corr_levels=2)
+    base = RAFTConfig.small_model(iters=2, corr_levels=2,
+                                  gru_ctx_hoist=False)
     hoisted = RAFTConfig.small_model(iters=2, corr_levels=2,
                                      gru_ctx_hoist=True)
     params, im1, im2 = _params_and_images(base, H=16, W=24)

@@ -256,7 +256,7 @@ def test_shard_inference_ctx_hoist_matches_single_device():
     still match the unsharded plain forward."""
     from raft_tpu.parallel import make_shard_inference_fn
 
-    plain = RAFTConfig.small_model(iters=2)
+    plain = RAFTConfig.small_model(iters=2, gru_ctx_hoist=False)
     hoisted = RAFTConfig.small_model(iters=2, gru_ctx_hoist=True)
     params = init_raft(jax.random.PRNGKey(0), plain)
     rng = np.random.RandomState(5)

@@ -37,9 +37,12 @@ def _run_pair(small: bool, B, H, W, iters, corr_impl="dense",
     sd = {k: v.detach().numpy() for k, v in tmodel.state_dict().items()}
     params = from_torch_state_dict(sd)
 
+    # literal (un-hoisted) GRU formulation unless a test opts in: the config
+    # DEFAULT is hoisted, and this oracle is what keeps the still-selectable
+    # --no-ctx-hoist path covered (the hoisted path has its own parity test)
     cfg = (RAFTConfig.small_model if small else RAFTConfig.full)(
         iters=iters, corr_impl=corr_impl, corr_lookup=corr_lookup,
-        compute_dtype="float32", **cfg_overrides)
+        compute_dtype="float32", **{"gru_ctx_hoist": False, **cfg_overrides})
     expected = init_raft(jax.random.PRNGKey(0), cfg)
     assert_tree_shapes_match(params, expected)
     params = jax.tree.map(jnp.asarray, params)

@@ -2,6 +2,7 @@
 BN-state handling, checkpoint round trip."""
 
 import dataclasses
+import os
 
 import numpy as np
 import pytest
@@ -786,3 +787,43 @@ def test_kitti_training_split_devkit_naming_and_metrics(tmp_path):
     assert out["samples"] == 2 and np.isfinite(out["epe"])
     assert sorted(p.name for p in sub.iterdir()) == \
         ["000000_10.png", "000001_10.png"]
+
+
+def test_sintel_submission_export(tmp_path):
+    """--dataset sintel --split testing --dump-flow exports
+    <dstype>/<scene>/frame_XXXX.flo predictions (the official
+    create_sintel_submission layout: the render-pass level keeps clean and
+    final exports from overwriting each other), with metrics skipped."""
+    import cv2
+
+    from raft_tpu.data.datasets import MpiSintel
+    from raft_tpu.training.evaluate import evaluate_dataset
+    from raft_tpu.utils import read_flo
+
+    rng = np.random.RandomState(0)
+    for scene in ("alley_2", "market_4"):
+        d = tmp_path / "test" / "clean" / scene
+        d.mkdir(parents=True)
+        for i in (1, 2, 3):
+            cv2.imwrite(str(d / f"frame_{i:04d}.png"),
+                        rng.randint(0, 255, (32, 48, 3), np.uint8))
+
+    ds = MpiSintel(str(tmp_path), "test", "clean")
+    assert len(ds) == 4 and not ds.has_gt      # 2 pairs per 3-frame scene
+    assert ds.dump_name(0) == os.path.join("clean", "alley_2",
+                                           "frame_0001.png")
+
+    config = RAFTConfig.small_model(iters=2)
+    params = init_raft(jax.random.PRNGKey(0), config)
+    sub = tmp_path / "submission"
+    out = evaluate_dataset(params, config, ds, batch_size=2,
+                           dump_dir=str(sub), verbose=False)
+    assert out["samples"] == 4 and "epe" not in out
+    files = sorted(str(p.relative_to(sub)) for p in sub.rglob("*.flo"))
+    assert files == [
+        os.path.join("clean", "alley_2", "frame_0001.flo"),
+        os.path.join("clean", "alley_2", "frame_0002.flo"),
+        os.path.join("clean", "market_4", "frame_0001.flo"),
+        os.path.join("clean", "market_4", "frame_0002.flo")], files
+    fl = read_flo(sub / "clean" / "alley_2" / "frame_0001.flo")
+    assert fl.shape == (32, 48, 2) and np.isfinite(fl).all()

@@ -72,6 +72,9 @@ run bench          2400 python bench.py
 run bench_train    1800 python tools/bench_train.py
 run bench_train_ctx 1200 python tools/bench_train.py --impl pallas-bf16corr-ctx
 run bench_accum    1200 python tools/bench_train.py --accum 2
+# scan_unroll was a wash on CPU (round-4 quiet-core A/B); only TPU can say
+# whether cross-iteration scheduling wins anything
+run bench_train_unroll2 1200 python tools/bench_train.py --unroll 2
 if [ "$all_ok" = 1 ]; then
   date -u +%Y-%m-%dT%H:%M:%SZ > "$OUT/.queue_done"
   echo "hw_queue COMPLETE $(date -u +%H:%M:%SZ)"
