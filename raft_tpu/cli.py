@@ -111,6 +111,11 @@ def _build_parser() -> argparse.ArgumentParser:
                         "hundred steps; EPE demonstrably drops from random "
                         "init, curve streamed to metrics.jsonl")
     p.add_argument("--num-steps", type=int, default=None)
+    p.add_argument("--freeze-bn", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="train mode: freeze batch-norm running stats "
+                        "(official recipe for every stage after chairs; "
+                        "the stage presets set this — the flag overrides)")
     p.add_argument("--ckpt-every", type=int, default=None, metavar="N",
                    help="train mode: checkpoint period in steps (default: "
                         "the stage preset's; shorten for failure-recovery "

@@ -151,6 +151,10 @@ class TrainConfig:
     schedule: str = "one_cycle"  # one_cycle | constant | cyclic
     pct_start: float = 0.05
     max_flow: float = 400.0      # exclude ground-truth flows beyond this
+    # Freeze batch norm during training (official recipe for every stage
+    # after chairs): running stats are used and left untouched; BN affine
+    # params still train.  Irrelevant for the small variant (no BN).
+    freeze_bn: bool = False
     # Failure detection/containment (SURVEY.md §5 listed 'none' for the
     # reference): drop updates with non-finite grads (optax.apply_if_finite),
     # and the loop halts with a clear error if the loss itself goes
@@ -171,13 +175,14 @@ class TrainConfig:
             "chairs":    dict(num_steps=100_000, lr=4e-4, batch_size=10,
                               image_size=(368, 496), weight_decay=1e-4),
             "things":    dict(num_steps=100_000, lr=1.25e-4, batch_size=6,
-                              image_size=(400, 720), weight_decay=1e-4),
+                              image_size=(400, 720), weight_decay=1e-4,
+                              freeze_bn=True),
             "sintel":    dict(num_steps=100_000, lr=1.25e-4, batch_size=6,
                               image_size=(368, 768), weight_decay=1e-5,
-                              gamma=0.85),
+                              gamma=0.85, freeze_bn=True),
             "kitti":     dict(num_steps=50_000, lr=1e-4, batch_size=6,
                               image_size=(288, 960), weight_decay=1e-5,
-                              gamma=0.85),
+                              gamma=0.85, freeze_bn=True),
             "synthetic": dict(image_size=(96, 128), batch_size=4,
                               log_every=10, ckpt_every=100),
         }

@@ -75,12 +75,19 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                  train: bool = False, axis_name: Optional[str] = None,
                  flow_init: Optional[jax.Array] = None,
                  all_flows: Optional[bool] = None,
-                 rng: Optional[jax.Array] = None
+                 rng: Optional[jax.Array] = None,
+                 freeze_bn: bool = False
                  ) -> Tuple[RAFTOutput, Dict[str, dict]]:
     """Run RAFT; returns (output, params-with-updated-BN-stats).
 
     all_flows defaults to ``train`` — training needs every iteration's
     upsampled flow for the sequence loss; inference only the last.
+
+    ``freeze_bn`` (only meaningful with ``train=True``) runs batch norm in
+    eval mode — running statistics used and not updated — while everything
+    else trains: the official finetune recipe (freeze_bn() for every stage
+    after chairs; TrainConfig.for_stage wires it).  Affine BN parameters
+    keep receiving gradients, matching torch ``.eval()`` semantics.
     """
     iters = config.iters if iters is None else iters
     all_flows = train if all_flows is None else all_flows
@@ -190,7 +197,8 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
 
     cnet, new_cnet_params = apply_encoder(
         params["cnet"], x1, cnet_norm, small=config.small, train=train,
-        axis_name=axis_name, dropout=config.dropout, rng=rngs[1])
+        axis_name=axis_name, dropout=config.dropout, rng=rngs[1],
+        bn_train=train and not freeze_bn)
     net = jnp.tanh(cnet[..., :config.hidden_dim])
     inp = jax.nn.relu(cnet[..., config.hidden_dim:])
 
@@ -243,8 +251,12 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
         flow = upsample(flow_lr, mask)
 
     new_params = dict(orig_params)
-    if train and not config.small:
+    if train and not config.small and not freeze_bn:
         # BN running stats updated in the cnet; restore original leaf dtypes.
+        # Under freeze_bn the ORIGINAL tree is returned untouched — the
+        # cast-down/cast-up round trip would otherwise bake bf16 rounding
+        # (~0.4% relative) into the frozen stats under
+        # compute_dtype='bfloat16', violating the left-untouched contract.
         new_params["cnet"] = jax.tree.map(
             lambda new, old: new.astype(old.dtype),
             new_cnet_params, orig_params["cnet"])

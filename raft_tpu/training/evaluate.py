@@ -109,7 +109,7 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
         from pathlib import Path
         from ..utils.flow_io import write_flo, write_kitti_flow
         Path(dump_dir).mkdir(parents=True, exist_ok=True)
-        stale = sum(1 for _ in Path(dump_dir).iterdir())
+        stale = sum(1 for p in Path(dump_dir).rglob("*") if p.is_file())
         if stale and verbose:
             # this run only overwrites the indices it visits — a shorter or
             # reordered run would leave a previous checkpoint's predictions
@@ -209,6 +209,12 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
     if getattr(args, "max_samples", None) is not None and args.max_samples < 1:
         # a zero/negative cap would 'succeed' with samples=0 — fail instead
         print(f"ERROR: --max-samples must be >= 1, got {args.max_samples}")
+        return 2
+    if getattr(args, "dstype", None) and args.dataset != "sintel":
+        # a silently-ignored render-pass flag on a submission export is the
+        # 'typo falls back silently' failure this repo validates against
+        print(f"ERROR: --dstype only applies to --dataset sintel "
+              f"(got --dataset {args.dataset})")
         return 2
     if getattr(args, "split", None) == "testing":
         if args.dataset not in ("kitti", "sintel"):

@@ -272,6 +272,8 @@ def train_cli(args, config: RAFTConfig) -> int:
         overrides["accum_steps"] = args.accum
     if getattr(args, "train_size", None):
         overrides["image_size"] = tuple(args.train_size)
+    if getattr(args, "freeze_bn", None) is not None:
+        overrides["freeze_bn"] = args.freeze_bn
     for flag in ("ckpt_every", "log_every"):
         val = getattr(args, flag, None)
         if val is not None:

@@ -48,7 +48,8 @@ def make_train_step(config: RAFTConfig, tconfig: TrainConfig,
             params = merge_bn_state(trainable, bn_state)
             out, new_params = raft_forward(
                 params, batch.image1, batch.image2, config, train=True,
-                axis_name=axis_name, rng=rng)
+                axis_name=axis_name, rng=rng,
+                freeze_bn=tconfig.freeze_bn)
             loss, metrics = sequence_loss(
                 out.flow_iters, batch.flow, batch.valid,
                 gamma=tconfig.gamma, max_flow=tconfig.max_flow,

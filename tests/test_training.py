@@ -827,3 +827,60 @@ def test_sintel_submission_export(tmp_path):
         os.path.join("clean", "market_4", "frame_0002.flo")], files
     fl = read_flo(sub / "clean" / "alley_2" / "frame_0001.flo")
     assert fl.shape == (32, 48, 2) and np.isfinite(fl).all()
+
+
+def test_freeze_bn_train_step():
+    """freeze_bn=True (the official recipe for every stage after chairs)
+    must leave BN running stats untouched through a train step while the
+    affine BN params and everything else keep training; the unfrozen step
+    on the same batch must move the stats."""
+    config = RAFTConfig.full(iters=2)
+    batch = _tiny_batch()
+    rng = jax.random.PRNGKey(1)
+
+    def run(freeze):
+        tconfig = TrainConfig(num_steps=10, lr=1e-3, schedule="constant",
+                              freeze_bn=freeze)
+        tx = make_optimizer(tconfig)
+        state = TrainState.create(init_raft(jax.random.PRNGKey(0), config), tx)
+        bn0 = jax.tree.map(np.asarray, state.bn_state)
+        state, metrics = jax.jit(make_train_step(config, tconfig, tx))(
+            state, batch, rng)
+        return bn0, state, metrics
+
+    bn0, s_frozen, m_frozen = run(True)
+    assert np.isfinite(float(m_frozen["loss"]))
+    for a, b in zip(jax.tree.leaves(bn0), jax.tree.leaves(s_frozen.bn_state)):
+        np.testing.assert_array_equal(np.asarray(b), a)   # stats untouched
+    # params (incl. BN gamma/beta) still moved — compare against the SAME
+    # trainable split (state.params excludes mean/var leaves; zipping the
+    # full init tree would misalign leaves after the first BN block)
+    t0, _ = split_bn_state(init_raft(jax.random.PRNGKey(0), config))
+    moved = any(not np.allclose(np.asarray(a), np.asarray(b))
+                for a, b in zip(jax.tree.leaves(s_frozen.params),
+                                jax.tree.leaves(t0)))
+    assert moved
+
+    _, s_live, _ = run(False)
+    assert any(not np.allclose(np.asarray(a), b)
+               for a, b in zip(jax.tree.leaves(s_live.bn_state),
+                               jax.tree.leaves(bn0)))
+
+    # official curriculum wiring: frozen after chairs, live for chairs
+    assert TrainConfig.for_stage("kitti").freeze_bn
+    assert TrainConfig.for_stage("things").freeze_bn
+    assert TrainConfig.for_stage("sintel").freeze_bn
+    assert not TrainConfig.for_stage("chairs").freeze_bn
+    assert not TrainConfig.for_stage("synthetic").freeze_bn
+
+    # bfloat16 compute: frozen stats must come back BIT-identical, not
+    # rounded through the bf16 cast at the top of raft_forward
+    cfg16 = RAFTConfig.full(iters=2, compute_dtype="bfloat16")
+    tconfig = TrainConfig(num_steps=10, lr=1e-3, schedule="constant",
+                          freeze_bn=True)
+    tx = make_optimizer(tconfig)
+    state = TrainState.create(init_raft(jax.random.PRNGKey(0), cfg16), tx)
+    bn0 = jax.tree.map(np.asarray, state.bn_state)
+    state, _ = jax.jit(make_train_step(cfg16, tconfig, tx))(state, batch, rng)
+    for a, b in zip(jax.tree.leaves(bn0), jax.tree.leaves(state.bn_state)):
+        np.testing.assert_array_equal(np.asarray(b), a)
