@@ -97,6 +97,11 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dstype", default=None, choices=["clean", "final"],
                    help="val mode, --dataset sintel: which render pass "
                         "(default clean; submissions need both)")
+    p.add_argument("--warm-start", action="store_true",
+                   help="val mode, --dataset sintel: official video "
+                        "protocol — each frame's low-res flow, forward-"
+                        "projected, seeds the next frame of the same scene "
+                        "(sequential; incompatible with --eval-batch)")
     p.add_argument("--eval-batch", type=int, default=None, metavar="N",
                    help="val mode: samples per device call, grouped by "
                         "padded shape (identical metrics; amortizes per-call "

@@ -159,18 +159,26 @@ class MpiSintel(FlowDataset):
                  augmentor: Optional[FlowAugmentor] = None):
         super().__init__(augmentor)
         self.dstype = dstype
+        self.scene_list: List[str] = []   # per-pair scene, for warm-start
         image_root = osp.join(root, split, dstype)
         flow_root = osp.join(root, split, "flow")
         for scene in sorted(glob(osp.join(image_root, "*"))):
             frames = sorted(glob(osp.join(scene, "*.png")))
             for a, b in zip(frames[:-1], frames[1:]):
                 self.image_list.append((a, b))
+                self.scene_list.append(osp.basename(scene))
             if split == "training":
                 self.flow_list += sorted(glob(
                     osp.join(flow_root, osp.basename(scene), "*.flo")))
         if split == "training":
             assert len(self.flow_list) == len(self.image_list), (
                 len(self.flow_list), len(self.image_list))
+
+    def is_scene_start(self, idx) -> bool:
+        """True when pair ``idx`` opens a new scene — the warm-start reset
+        points of the official Sintel evaluation (consecutive pairs within
+        a scene share motion; across scenes the previous flow is garbage)."""
+        return idx == 0 or self.scene_list[idx] != self.scene_list[idx - 1]
 
     def dump_name(self, idx) -> str:
         """Relative prediction path for submission export:

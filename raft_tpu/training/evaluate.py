@@ -50,6 +50,7 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
                      pad_mode: str = "sintel", bucket: int = 8,
                      weighting: str = "sample", batch_size: int = 1,
                      dump_dir: Optional[str] = None,
+                     warm_start: bool = False,
                      verbose: bool = True) -> Dict[str, float]:
     """dataset yields (im1, im2, flow_gt, valid) numpy samples (augmentor=None).
 
@@ -81,6 +82,12 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
     dataset (``has_gt == False``, e.g. the KITTI testing split) metrics are
     skipped and this becomes a pure submission export — the official repo's
     create_kitti_submission equivalent.
+
+    ``warm_start``: the official Sintel video protocol — within a scene,
+    each frame's 1/8-res flow is forward-projected along itself
+    (utils.frame_utils.forward_interpolate) and seeds the next frame's
+    recurrence; scene boundaries (``dataset.is_scene_start``) reset to a
+    cold start.  Sequential, so requires ``batch_size == 1``.
     """
     assert bucket % 8 == 0 and bucket > 0, bucket
     assert batch_size >= 1, batch_size
@@ -118,15 +125,9 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
                   f"{stale} file(s); stale predictions from a previous run "
                   f"will remain unless overwritten")
 
-    def flush(group):
+    def account(flows_dev, group):
+        """Metrics + dump + progress for already-computed (padded) flows."""
         nonlocal count
-        # record the executable's ACTUAL input shape (batch included): with
-        # batching, a shape group costs one compile per distinct flush size
-        # (full batches + at most one remainder)
-        shapes_seen.add((len(group),) + group[0][0].shape[1:])
-        flows_dev = eval_fn(
-            params, jnp.asarray(np.concatenate([g[0] for g in group])),
-            jnp.asarray(np.concatenate([g[1] for g in group])))
         if has_gt:
             hw = group[0][0].shape[1:3]
             canv = [_gt_canvas(g[3], g[4], g[2], hw) for g in group]
@@ -167,19 +168,62 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
                        if weighting == "pixel" else sums["epe"] / count)
             print(f"  eval {count}/{n}  epe so far {running:.3f}")
 
-    groups: Dict[tuple, list] = {}
-    for idx in range(n):
-        im1, im2, flow_gt, valid = dataset[idx]
-        im1p, pads = pad_to_multiple(im1[None], bucket, pad_mode)
-        im2p, _ = pad_to_multiple(im2[None], bucket, pad_mode)
-        group = groups.setdefault(im1p.shape, [])
-        group.append((im1p, im2p, pads, flow_gt, valid, idx))
-        if len(group) == batch_size:
-            flush(group)
-            group.clear()
-    for group in groups.values():   # shape-group remainders
-        if group:
-            flush(group)
+    def flush(group):
+        # record the executable's ACTUAL input shape (batch included): with
+        # batching, a shape group costs one compile per distinct flush size
+        # (full batches + at most one remainder)
+        shapes_seen.add((len(group),) + group[0][0].shape[1:])
+        flows_dev = eval_fn(
+            params, jnp.asarray(np.concatenate([g[0] for g in group])),
+            jnp.asarray(np.concatenate([g[1] for g in group])))
+        account(flows_dev, group)
+
+    if warm_start:
+        # Official Sintel warm-start protocol: within a scene, frame t's
+        # low-res flow — forward-projected along itself — seeds frame t+1;
+        # scene boundaries reset to a cold (zeros) start.  Sequential by
+        # construction, so batching is rejected rather than silently
+        # reordered.
+        from ..utils.frame_utils import forward_interpolate
+        from .step import make_warm_eval_step
+        if batch_size != 1:
+            raise ValueError("warm_start evaluation is sequential (frame t "
+                             "seeds frame t+1): use --eval-batch 1")
+        if not hasattr(dataset, "is_scene_start"):
+            raise ValueError(
+                "warm_start needs a dataset with scene structure "
+                "(is_scene_start), e.g. MpiSintel")
+        warm_fn = jax.jit(make_warm_eval_step(config, iters=iters))
+        prev_lr = None
+        for idx in range(n):
+            im1, im2, flow_gt, valid = dataset[idx]
+            im1p, pads = pad_to_multiple(im1[None], bucket, pad_mode)
+            im2p, _ = pad_to_multiple(im2[None], bucket, pad_mode)
+            shapes_seen.add((1,) + im1p.shape[1:])
+            h8, w8 = im1p.shape[1] // 8, im1p.shape[2] // 8
+            if (dataset.is_scene_start(idx) or prev_lr is None
+                    or prev_lr.shape[1:3] != (h8, w8)):
+                init = np.zeros((1, h8, w8, 2), np.float32)
+            else:
+                init = forward_interpolate(prev_lr[0])[None]
+            flow_dev, lr_dev = warm_fn(params, jnp.asarray(im1p),
+                                       jnp.asarray(im2p), jnp.asarray(init))
+            prev_lr = np.asarray(lr_dev)
+            account(flow_dev, [(im1p, im2p, pads, flow_gt, valid, idx)])
+    else:
+        groups: Dict[tuple, list] = {}
+        for idx in range(n):
+            im1, im2, flow_gt, valid = dataset[idx]
+            im1p, pads = pad_to_multiple(im1[None], bucket, pad_mode)
+            im2p, _ = pad_to_multiple(im2[None], bucket, pad_mode)
+            group = groups.setdefault(im1p.shape, [])
+            group.append((im1p, im2p, pads, flow_gt, valid, idx))
+            if len(group) == batch_size:
+                flush(group)
+                group.clear()
+        for group in groups.values():   # shape-group remainders
+            if group:
+                flush(group)
     if weighting == "pixel":
         denom = max(sums.pop("valid_px", 0.0), 1.0)
         out = {k: v / denom for k, v in sums.items()}
@@ -210,6 +254,16 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
         # a zero/negative cap would 'succeed' with samples=0 — fail instead
         print(f"ERROR: --max-samples must be >= 1, got {args.max_samples}")
         return 2
+    if getattr(args, "warm_start", False):
+        if args.dataset != "sintel":
+            print("ERROR: --warm-start is the Sintel video protocol "
+                  "(scene-structured frame sequences); only --dataset "
+                  "sintel supports it")
+            return 2
+        if getattr(args, "eval_batch", None) not in (None, 1):
+            print("ERROR: --warm-start is sequential (frame t seeds frame "
+                  "t+1); drop --eval-batch")
+            return 2
     if getattr(args, "dstype", None) and args.dataset != "sintel":
         # a silently-ignored render-pass flag on a submission export is the
         # 'typo falls back silently' failure this repo validates against
@@ -286,6 +340,7 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
                                weighting=weighting,
                                batch_size=getattr(args, "eval_batch", None) or 1,
                                dump_dir=getattr(args, "dump_flow", None),
+                               warm_start=getattr(args, "warm_start", False),
                                max_samples=getattr(args, "max_samples", None))
     name = f"{args.dataset} ({'small' if args.small else 'full'})"
     if not getattr(ds, "has_gt", True):

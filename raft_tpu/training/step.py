@@ -123,3 +123,20 @@ def make_eval_step(config: RAFTConfig, iters: Optional[int] = None):
         return out.flow
 
     return eval_step
+
+
+def make_warm_eval_step(config: RAFTConfig, iters: Optional[int] = None):
+    """Returns step(params, image1, image2, flow_init) ->
+    (full-res flow, low-res flow) — the official Sintel warm-start
+    evaluation step: ``flow_init`` (1/8 resolution; zeros = cold start,
+    identical to no init) seeds the recurrence, and the returned low-res
+    flow is forward-projected (utils.frame_utils.forward_interpolate) to
+    seed the next frame of the same scene."""
+
+    def eval_step(params, image1, image2, flow_init):
+        out, _ = raft_forward(params, image1, image2, config, iters=iters,
+                              train=False, all_flows=False,
+                              flow_init=flow_init)
+        return out.flow, out.flow_lr
+
+    return eval_step

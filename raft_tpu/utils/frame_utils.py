@@ -116,6 +116,82 @@ def _nearest_fill(values: np.ndarray, empty: np.ndarray) -> np.ndarray:
     return out
 
 
+def _splat_average(flow: np.ndarray, values: np.ndarray,
+                   skip: Optional[np.ndarray] = None,
+                   oob: str = "clip") -> tuple:
+    """Scatter-average ``values`` at each pixel's rounded flow target
+    (conflict averaging).  The one splat kernel shared by flow reversal and
+    warm-start projection — their semantics differ only in the
+    out-of-bounds policy:
+
+    - ``oob="clip"``: exiting targets pin to the border (the reference
+      reversal semantics, flow_utils.py:166-274).
+    - ``oob="discard"``: exiting pixels are dropped, tested on the
+      UNROUNDED target like the official warm-start's strict
+      ``(x1 > 0) & (x1 < wd)`` mask — border cells then fill from in-frame
+      hits instead of inheriting the exiting motion.
+
+    Returns (averaged [H, W, C] float64, hit mask [H, W] bool,
+    hit count [H, W] float64)."""
+    h, w = flow.shape[:2]
+    tx = flow[:, :, 0] + np.arange(w)
+    ty = flow[:, :, 1] + np.arange(h)[:, None]
+    if oob == "discard":
+        keep = (tx > 0) & (tx < w) & (ty > 0) & (ty < h)
+    elif oob == "clip":
+        keep = np.ones((h, w), bool)
+    else:
+        raise ValueError(f"oob must be 'clip' or 'discard', got {oob!r}")
+    if skip is not None:
+        keep &= ~skip
+    txi = np.clip(np.rint(tx), 0, w - 1).astype(np.int64)
+    tyi = np.clip(np.rint(ty), 0, h - 1).astype(np.int64)
+    flat_idx = (tyi * w + txi)[keep]
+    acc = np.zeros((h * w, values.shape[-1]), np.float64)
+    count = np.zeros(h * w, np.float64)
+    np.add.at(acc, flat_idx, values[keep])
+    np.add.at(count, flat_idx, 1.0)
+    hit = count > 1e-7
+    acc[hit] /= count[hit, None]
+    return (acc.reshape(h, w, -1), hit.reshape(h, w),
+            count.reshape(h, w))
+
+
+def forward_interpolate(flow: np.ndarray) -> np.ndarray:
+    """Forward-project a flow field along itself: each source pixel carries
+    its flow VALUE to its rounded target position (conflict averaging), and
+    unhit pixels are filled from their nearest hit neighbor.
+
+    This is the warm-start initializer of the official RAFT Sintel
+    evaluation (frame t's low-res flow, projected forward, seeds frame
+    t+1's recurrence).  The official code scatters through
+    scipy.interpolate.griddata(nearest) after discarding pixels whose
+    target leaves the frame; this is a vectorized splat (same discard
+    policy) + a GLOBAL nearest fill via distance-transform labels — the
+    same dense nearest-extrapolation semantics without the per-call
+    Delaunay cost.  (The axis-only ``_nearest_fill`` used by flow reversal
+    is not enough here: a uniform flow leaves whole corner regions with no
+    hit in their row or column.)
+    In/out: [H, W, 2] float32 (any resolution; RAFT uses the 1/8 grid)."""
+    h, w = flow.shape[:2]
+    f = flow.astype(np.float64)
+    out, hit, _ = _splat_average(f, f, oob="discard")
+    if not hit.any():
+        return np.zeros_like(flow, dtype=np.float32)
+    empty = np.uint8(~hit)
+    if empty.any():
+        import cv2
+        # label of the nearest hit pixel for every pixel; OpenCV numbers the
+        # zero pixels of `empty` (the hits) 1..N in row-major scan order
+        _, labels = cv2.distanceTransformWithLabels(
+            empty, cv2.DIST_L2, 3, labelType=cv2.DIST_LABEL_PIXEL)
+        hit_rc = np.argwhere(empty == 0)
+        nearest = hit_rc[labels - 1]                 # [H, W, 2] (row, col)
+        fill = empty.astype(bool)
+        out[fill] = out[nearest[fill][:, 0], nearest[fill][:, 1]]
+    return out.astype(np.float32)
+
+
 def reverse_flow(flow01: np.ndarray, bg: Optional[np.ndarray] = None,
                  im0: Optional[np.ndarray] = None, time_step: float = 1.0,
                  static_thresh: float = 10.0) -> ReversedFlow:
@@ -134,21 +210,8 @@ def reverse_flow(flow01: np.ndarray, bg: Optional[np.ndarray] = None,
         static_mask = np.zeros((h, w, 1))
         skip = np.zeros((h, w), bool)
 
-    tx = np.clip(np.rint(flow[:, :, 0] + np.arange(w)), 0, w - 1).astype(np.int64)
-    ty = np.clip(np.rint(flow[:, :, 1] + np.arange(h)[:, None]), 0, h - 1).astype(np.int64)
-
-    keep = ~skip
-    flat_idx = (ty * w + tx)[keep]
-    flow10 = np.zeros((h * w, 2), np.float64)
-    count = np.zeros(h * w, np.float64)
-    np.add.at(flow10, flat_idx, -flow[keep])
-    np.add.at(count, flat_idx, 1.0)
-
-    hit = count > 1e-7
-    flow10[hit] /= count[hit, None]
-    flow10 = flow10.reshape(h, w, 2)
-    count = count.reshape(h, w)
-    empty = np.uint8(~hit.reshape(h, w))
+    flow10, hit, count = _splat_average(flow, -flow, skip=skip, oob="clip")
+    empty = np.uint8(~hit)
     empty_before_fill = empty.copy()
 
     flow10 = _nearest_fill(flow10, empty)

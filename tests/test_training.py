@@ -884,3 +884,51 @@ def test_freeze_bn_train_step():
     state, _ = jax.jit(make_train_step(cfg16, tconfig, tx))(state, batch, rng)
     for a, b in zip(jax.tree.leaves(bn0), jax.tree.leaves(state.bn_state)):
         np.testing.assert_array_equal(np.asarray(b), a)
+
+
+def test_sintel_warm_start_eval(tmp_path):
+    """Official Sintel video protocol: within a scene each frame's low-res
+    flow (forward-projected) seeds the next; scene boundaries reset.  The
+    warm run must produce different (finite) metrics from the cold run on
+    multi-frame scenes, refuse batching, and require scene structure."""
+    import cv2
+
+    from raft_tpu.data.datasets import MpiSintel
+    from raft_tpu.training.evaluate import evaluate_dataset
+    from raft_tpu.utils.flow_io import write_flo
+
+    rng = np.random.RandomState(3)
+    for scene in ("bamboo_1", "temple_2"):
+        d = tmp_path / "training" / "clean" / scene
+        f = tmp_path / "training" / "flow" / scene
+        d.mkdir(parents=True)
+        f.mkdir(parents=True)
+        for i in (1, 2, 3):
+            cv2.imwrite(str(d / f"frame_{i:04d}.png"),
+                        rng.randint(0, 255, (32, 48, 3), np.uint8))
+            if i < 3:
+                write_flo((rng.randn(32, 48, 2) * 2).astype(np.float32),
+                          f / f"frame_{i:04d}.flo")
+
+    ds = MpiSintel(str(tmp_path), "training", "clean")
+    assert len(ds) == 4
+    assert [ds.is_scene_start(i) for i in range(4)] == \
+        [True, False, True, False]
+
+    config = RAFTConfig.small_model(iters=2)
+    params = init_raft(jax.random.PRNGKey(0), config)
+
+    cold = evaluate_dataset(params, config, ds, verbose=False)
+    warm = evaluate_dataset(params, config, ds, warm_start=True,
+                            verbose=False)
+    assert warm["samples"] == cold["samples"] == 4
+    assert np.isfinite(warm["epe"]) and np.isfinite(cold["epe"])
+    # the second frame of each scene is seeded by the first: results differ
+    assert abs(warm["epe"] - cold["epe"]) > 1e-6, (warm["epe"], cold["epe"])
+
+    with pytest.raises(ValueError, match="sequential"):
+        evaluate_dataset(params, config, ds, warm_start=True, batch_size=2,
+                         verbose=False)
+    with pytest.raises(ValueError, match="scene structure"):
+        evaluate_dataset(params, config, _MixedResolutionDataset(),
+                         warm_start=True, verbose=False)

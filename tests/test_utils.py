@@ -146,3 +146,36 @@ def test_reverse_flow_static_skip():
     out = reverse_flow(flow01, bg=bg, im0=im0)
     assert out.static_mask.all()
     assert out.empty.all()                        # nothing projected
+
+
+def test_forward_interpolate():
+    """Warm-start projector: a CONSTANT flow field is a fixed point (every
+    pixel carries the same value somewhere, holes fill with that value);
+    zero flow is the identity; values land at their rounded targets."""
+    from raft_tpu.utils.frame_utils import forward_interpolate
+
+    const = np.full((10, 14, 2), (3.0, -2.0), np.float32)
+    np.testing.assert_allclose(forward_interpolate(const), const)
+
+    rng = np.random.RandomState(0)
+    f = rng.randn(8, 12, 2).astype(np.float32)
+    np.testing.assert_allclose(forward_interpolate(np.zeros_like(f) + 0.0),
+                               np.zeros_like(f))
+
+    # single moving pixel: its value lands at the rounded target, averaged
+    # with the stationary pixel already occupying that cell (the splat's
+    # conflict-averaging; griddata-nearest would pick one arbitrarily)
+    f = np.zeros((6, 8, 2), np.float32)
+    f[2, 3] = (2.0, 1.0)          # -> lands at (y=3, x=5)
+    out = forward_interpolate(f)
+    np.testing.assert_allclose(out[3, 5], (1.0, 0.5))
+    assert np.isfinite(out).all() and out.shape == f.shape
+
+    # official discard policy: pixels whose target EXITS the frame are
+    # dropped (not clamped onto the border), so exiting motion must not
+    # contaminate the border seed — those cells fill from in-frame hits
+    f = np.zeros((8, 16, 2), np.float32)
+    f[:, 8:, 0] = 30.0            # right half exits the 16-wide frame
+    out = forward_interpolate(f)
+    np.testing.assert_allclose(out[:, 15], 0.0)   # border seeded from calm side
+    np.testing.assert_allclose(out[:, :8], 0.0)
