@@ -248,12 +248,13 @@ def _run(args, t_start: float, result: dict) -> None:
     # dense fp32 corr volume + gather lookup, hardcoded 20 iters
     ref = None
     try:
-        # explicit literal GRU (gru_ctx_hoist defaults True since round 4):
-        # the baseline must stay the REFERENCE's formulation — dense fp32
-        # volume, gather lookup, no hoist — or vs_baseline is measured
-        # against an already-optimized 'reference'
+        # explicit literal formulation (gru_ctx_hoist and corr_lookup
+        # defaults are the round-4 measured winners): the baseline must stay
+        # the REFERENCE's semantics — dense fp32 volume, gather lookup, no
+        # hoist — or vs_baseline is measured against an already-optimized
+        # 'reference'
         ref_cfg = RAFTConfig.full(corr_impl="dense", compute_dtype="float32",
-                                  gru_ctx_hoist=False)
+                                  corr_lookup="gather", gru_ctx_hoist=False)
         ref, ref_mfu = throughput(ref_cfg, 20)
         print(f"# reference-config (dense fp32, 20 iters): {ref:.3f} pairs/s"
               + (f"  mfu={ref_mfu:.3f}" if ref_mfu else ""), file=sys.stderr)

@@ -47,6 +47,11 @@ def _build_parser() -> argparse.ArgumentParser:
                         "preset's batch for train, 4 under --demo-train)")
     p.add_argument("--corr-impl", default="dense",
                    choices=["dense", "blockwise", "pallas"])
+    p.add_argument("--corr-lookup", default=None,
+                   choices=["gather", "onehot"],
+                   help="window-lookup formulation (default onehot — "
+                        "measured winner on TPU and CPU; 'gather' is the "
+                        "reference's SampleCorr semantics)")
     p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
     p.add_argument("--ctx-hoist", action=argparse.BooleanOptionalAction,
                    default=None,
@@ -181,6 +186,8 @@ def _make_config(args):
     overrides = dict(corr_impl=args.corr_impl, compute_dtype=args.dtype)
     if args.ctx_hoist is not None:       # tri-state: None = config default
         overrides["gru_ctx_hoist"] = args.ctx_hoist
+    if args.corr_lookup is not None:
+        overrides["corr_lookup"] = args.corr_lookup
     if args.iters is not None:
         overrides["iters"] = args.iters
     if args.small:

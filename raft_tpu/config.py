@@ -40,8 +40,10 @@ class RAFTConfig:
     # Window-lookup formulation for the dense impl: 'gather'
     # (take_along_axis, the reference's SampleCorr semantics) or 'onehot'
     # (separable one-hot interpolation matmuls — MXU work instead of
-    # gathers, typically faster on TPU).
-    corr_lookup: str = "gather"
+    # gathers).  Default 'onehot' from measured data on BOTH backends:
+    # TPU v5e 18.09 vs 11.42 pairs/s (round-2 bench table, PERF.md) and
+    # CPU +12% (round-4 A/B); identical values (parity-tested vs gather).
+    corr_lookup: str = "onehot"
     # MXU precision of the fused kernel's correlation matmul ('highest' =
     # true-f32 multi-pass, honoring the fp32-corr policy; 'default' = bf16
     # MXU inputs, matching the dense/blockwise einsum default and ~1.6x

@@ -96,6 +96,18 @@ def test_full_model_torch_parity_blockwise_onehot():
     assert err <= 1e-3 + 1e-3 * scale, (err, scale)
 
 
+def test_full_model_torch_parity_dense_onehot_default():
+    """dense + onehot + ctx-hoist is the SHIPPING default config since
+    round 4 (both knobs measured winners) — the exact default path needs
+    its own full-model oracle, not just the gather correctness reference."""
+    tflows, jflows = _run_pair(False, B=1, H=128, W=128, iters=2,
+                               corr_impl="dense", corr_lookup="onehot",
+                               gru_ctx_hoist=True)
+    err = np.abs(tflows[-1] - jflows[-1]).max()
+    scale = np.abs(tflows[-1]).max()
+    assert err <= 1e-3 + 1e-3 * scale, (err, scale)
+
+
 def test_full_model_torch_parity_pallas_winpack():
     """The fused kernel's window schedule + row packing must match the
     official model end-to-end (W=128 -> fmap width 16: pack 8 at level 0).
