@@ -179,16 +179,9 @@ def test_sparse_augmentor_flip_transforms_flow_and_valid():
 
 
 def test_sintel_dataset(tmp_path):
-    root = tmp_path / "sintel"
-    for scene in ("alley_1", "ambush_2"):
-        (root / "training" / "clean" / scene).mkdir(parents=True)
-        (root / "training" / "flow" / scene).mkdir(parents=True)
-        for i in range(3):
-            _write_png(root / "training" / "clean" / scene / f"frame_{i:04d}.png",
-                       seed=i)
-        for i in range(2):
-            write_flo(np.random.RandomState(i).randn(64, 96, 2).astype(np.float32),
-                      root / "training" / "flow" / scene / f"frame_{i:04d}.flo")
+    from conftest import make_sintel_tree
+    root = make_sintel_tree(tmp_path / "sintel",
+                            scenes=("alley_1", "ambush_2"), size=(64, 96))
     ds = MpiSintel(str(root), "training", "clean")
     assert len(ds) == 4            # 2 scenes x 2 consecutive pairs
     im1, im2, flow, valid = ds[0]
