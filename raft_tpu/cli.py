@@ -61,6 +61,11 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rgb", action="store_true",
                    help="input is RGB (default BGR, matching the reference)")
     p.add_argument("--save-flo", action="store_true", help="also write .flo")
+    p.add_argument("--export-reference-npz", action="store_true",
+                   help="export mode: additionally write the params in the "
+                        "reference's tensorpack npz naming (W/gamma/mean-EMA "
+                        "leaves, SURVEY.md §3.4) — loadable by the "
+                        "reference's own weight-load path")
     p.add_argument("--show", action="store_true", help="cv2.imshow the result")
     p.add_argument("--cpu", action="store_true", help="force the CPU backend")
     p.add_argument("--spatial", type=int, default=None, metavar="N",
@@ -98,7 +103,8 @@ def _build_parser() -> argparse.ArgumentParser:
                         "truth — metrics are skipped and --dump-flow is "
                         "required, producing a server-submission directory: "
                         "devkit <frame>_10.png PNGs for kitti, "
-                        "<scene>/frame_XXXX.flo for sintel)")
+                        "<dstype>/<scene>/frame%%04d.flo for sintel — the "
+                        "official create_sintel_submission naming)")
     p.add_argument("--dstype", default=None, choices=["clean", "final"],
                    help="val mode, --dataset sintel: which render pass "
                         "(default clean; submissions need both)")
@@ -322,7 +328,7 @@ def mode_flops(args) -> int:
 def mode_export(args) -> int:
     import jax
     import jax.numpy as jnp
-    from .convert import save_params_npz
+    from .convert import save_params_npz, to_reference_npz
     from .models.raft import make_inference_fn
 
     config = _make_config(args)
@@ -334,6 +340,11 @@ def mode_export(args) -> int:
     ckpt = outdir / f"{variant}.npz"
     save_params_npz(jax.tree.map(np.asarray, params), ckpt)
     print(f"wrote {ckpt}")
+
+    if args.export_reference_npz:
+        ref = outdir / f"{variant}.reference.npz"
+        to_reference_npz(jax.tree.map(np.asarray, params), ref)
+        print(f"wrote {ref} (reference/tensorpack naming, SURVEY.md §3.4)")
 
     h, w = args.size
     im = jnp.zeros((args.batch, h, w, 3), jnp.float32)

@@ -1,4 +1,4 @@
 from .weights import (assert_tree_shapes_match, from_reference_npz,
                       from_torch_state_dict, load_checkpoint_auto,
                       load_params_npz, save_params_npz, swap_rgb_bgr,
-                      to_state_dict)
+                      to_reference_npz, to_state_dict)

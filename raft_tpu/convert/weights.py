@@ -148,6 +148,37 @@ def from_reference_npz(path_or_dict, strict: bool = True) -> Dict[str, dict]:
     return params
 
 
+# derived inverse of _TP_LEAVES so import/export cannot silently diverge
+_TP_LEAVES_INV = {v: k for k, v in _TP_LEAVES.items()}
+
+
+def _flatten_tree(node: Mapping, prefix=()):
+    """Yield (path_parts, leaf_key, value) for every leaf of a params tree."""
+    for k, v in node.items():
+        if isinstance(v, dict):
+            yield from _flatten_tree(v, prefix + (k,))
+        else:
+            yield prefix, k, v
+
+
+def to_reference_npz(params: Dict[str, dict], path=None) -> Dict[str, np.ndarray]:
+    """Export a params pytree in the reference's checkpoint naming (SURVEY.md
+    §3.4: tensorpack variable names — '/'-separated module path, leaves
+    ``W``/``b``/``gamma``/``beta``/``mean/EMA``/``variance/EMA``, HWIO
+    kernels) — the exact inverse of :func:`from_reference_npz`, so interop
+    with a reference-consuming pipeline is proven in BOTH directions
+    (reference infer_raft.py:77 loads exactly this shape of npz).  Returns
+    the flat dict; also writes it to ``path`` when given."""
+    flat: Dict[str, np.ndarray] = {}
+    for parts, k, v in _flatten_tree(params):
+        if k not in _TP_LEAVES_INV:
+            raise ValueError(f"unknown leaf {k!r} at {'/'.join(parts)}")
+        flat["/".join(parts + (_TP_LEAVES_INV[k],))] = np.asarray(v)
+    if path is not None:
+        np.savez(path, **flat)
+    return flat
+
+
 def to_state_dict(params: Dict[str, dict], torch_layout: bool = True) -> Dict[str, np.ndarray]:
     """Flatten a params pytree back to a torch-style state_dict (for export
     and round-trip testing)."""
@@ -182,16 +213,8 @@ def to_state_dict(params: Dict[str, dict], torch_layout: bool = True) -> Dict[st
 def save_params_npz(params: Dict[str, dict], path) -> None:
     """Save a params pytree as a flat npz ('/'-joined keys, HWIO layout) —
     the native raft-tpu single-file checkpoint format."""
-    flat: Dict[str, np.ndarray] = {}
-
-    def walk(node, prefix):
-        for k, v in node.items():
-            if isinstance(v, dict):
-                walk(v, prefix + [k])
-            else:
-                flat["/".join(prefix + [k])] = np.asarray(v)
-
-    walk(params, [])
+    flat = {"/".join(parts + (k,)): np.asarray(v)
+            for parts, k, v in _flatten_tree(params)}
     np.savez(path, **flat)
 
 

@@ -160,13 +160,15 @@ class MpiSintel(FlowDataset):
         super().__init__(augmentor)
         self.dstype = dstype
         self.scene_list: List[str] = []   # per-pair scene, for warm-start
+        self.pair_in_scene: List[int] = []  # 0-based pair index within scene
         image_root = osp.join(root, split, dstype)
         flow_root = osp.join(root, split, "flow")
         for scene in sorted(glob(osp.join(image_root, "*"))):
             frames = sorted(glob(osp.join(scene, "*.png")))
-            for a, b in zip(frames[:-1], frames[1:]):
+            for k, (a, b) in enumerate(zip(frames[:-1], frames[1:])):
                 self.image_list.append((a, b))
                 self.scene_list.append(osp.basename(scene))
+                self.pair_in_scene.append(k)
             if split == "training":
                 self.flow_list += sorted(glob(
                     osp.join(flow_root, osp.basename(scene), "*.flo")))
@@ -182,14 +184,19 @@ class MpiSintel(FlowDataset):
 
     def dump_name(self, idx) -> str:
         """Relative prediction path for submission export:
-        ``<dstype>/<scene>/frame_XXXX.png`` (the eval harness swaps the
-        extension to .flo) — the official create_sintel_submission layout.
-        The render-pass level matters: a submission needs BOTH clean and
-        final, and without it the two exports into one --dump-flow dir
-        would silently overwrite each other (identical scene/frame names)."""
-        a = self.image_list[idx][0]
-        return osp.join(self.dstype, osp.basename(osp.dirname(a)),
-                        osp.basename(a))
+        ``<dstype>/<scene>/frame%04d.png`` (the eval harness swaps the
+        extension to .flo) — byte-identical to the official
+        create_sintel_submission naming: ``'frame%04d.flo' % (frame+1)``
+        with NO underscore, numbered by the 0-based pair index within the
+        scene, not the image basename.  (The input images are
+        ``frame_XXXX.png`` with an underscore; the official submission
+        script drops it, so we do too rather than claim untested server
+        acceptance of a variant spelling.)  The render-pass level matters:
+        a submission needs BOTH clean and final, and without it the two
+        exports into one --dump-flow dir would silently overwrite each
+        other (identical scene/frame names)."""
+        return osp.join(self.dstype, self.scene_list[idx],
+                        "frame%04d.png" % (self.pair_in_scene[idx] + 1))
 
 
 class FlyingChairs(FlowDataset):

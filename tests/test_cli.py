@@ -170,3 +170,26 @@ def test_val_sintel_submission_and_warm_start_flags(tmp_path, capsys):
     assert cli.main(["-m", "val", "--dataset", "sintel", "--dstype", "final",
                      "--data", str(root), "--small", "--cpu",
                      "--warm-start", "--eval-batch", "4"]) == 2
+
+
+def test_mode_export_reference_npz(tmp_path, capsys):
+    """-m export writes the native params npz + StableHLO, and with
+    --export-reference-npz additionally the reference/tensorpack-named npz
+    (SURVEY.md §3.4) — which must reload through the auto-detector into the
+    same tree the native file holds."""
+    import jax
+    import numpy as np
+    from raft_tpu.convert import assert_tree_shapes_match, load_checkpoint_auto
+
+    rc = cli.main(["-m", "export", "--small", "--iters", "2",
+                   "--size", "48", "64", "--out", str(tmp_path),
+                   "--export-reference-npz"])
+    assert rc == 0
+    native = tmp_path / "raft-small.npz"
+    ref = tmp_path / "raft-small.reference.npz"
+    assert native.exists() and ref.exists()
+    assert (tmp_path / "raft-small.stablehlo.txt").stat().st_size > 0
+    a, b = load_checkpoint_auto(native), load_checkpoint_auto(ref)
+    assert_tree_shapes_match(b, a)
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(x, y)

@@ -120,3 +120,45 @@ def test_small_model_roundtrip():
     assert "fnet.layer1.0.conv3.weight" in sd    # bottleneck blocks
     back = from_torch_state_dict(sd)
     assert_tree_shapes_match(back, params)
+
+
+def test_reference_npz_export_roundtrip(tmp_path, full_params):
+    """to_reference_npz is the exact inverse of from_reference_npz: export
+    this repo's params in the reference's tensorpack naming (SURVEY.md §3.4,
+    reference infer_raft.py:77), reload through BOTH the direct loader and
+    the auto-detector, and require bit-identical values — interop proven in
+    both directions, not just reference->us."""
+    from raft_tpu.convert import to_reference_npz
+
+    p = tmp_path / "export.reference.npz"
+    flat = to_reference_npz(full_params, p)
+    # the names the reference's loader expects
+    assert "fnet/layer1/0/conv1/W" in flat
+    assert "cnet/norm1/mean/EMA" in flat
+    assert "cnet/norm1/variance/EMA" in flat
+    assert "update_block/gru/convz1/W" in flat
+    assert flat["fnet/conv1/W"].shape == (7, 7, 3, 64)      # HWIO, untransposed
+
+    back = from_reference_npz(p)
+    assert_tree_shapes_match(back, full_params)
+    for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(full_params)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+    auto = load_checkpoint_auto(p)                          # detects tensorpack
+    assert_tree_shapes_match(auto, full_params)
+
+
+def test_pth_model_wrapper_layout(tmp_path, full_params):
+    """Current torch exports often save {'model': state_dict} (plus the
+    DataParallel 'module.' prefix inside) — the .pth auto-loader must unwrap
+    both."""
+    import torch
+
+    sd = {f"module.{k}": torch.from_numpy(np.asarray(v))
+          for k, v in to_state_dict(full_params).items()}
+    p = tmp_path / "ckpt.pth"
+    torch.save({"model": sd}, p)
+    back = load_checkpoint_auto(p)
+    assert_tree_shapes_match(back, full_params)
+    np.testing.assert_array_equal(back["fnet"]["conv1"]["w"],
+                                  np.asarray(full_params["fnet"]["conv1"]["w"]))

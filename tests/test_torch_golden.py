@@ -263,6 +263,21 @@ def test_official_state_dict_shape_contract():
     assert_tree_shapes_match(params, expected)
 
 
+def test_official_state_dict_shape_contract_small():
+    """Same contract for the raft-small variant (bottleneck blocks, instance
+    norms, ConvGRU): the converter must digest a REAL official-architecture
+    small state_dict — with the DataParallel 'module.' prefix current torch
+    exports carry — into exactly our small init tree."""
+    torch.manual_seed(2)
+    tmodel = TorchRAFT(small=True).eval()
+    sd = {f"module.{k}": v.detach().numpy()
+          for k, v in tmodel.state_dict().items()}
+    assert "module.fnet.layer1.0.conv3.weight" in sd       # bottleneck
+    params = from_torch_state_dict(sd)
+    expected = init_raft(jax.random.PRNGKey(0), RAFTConfig.small_model())
+    assert_tree_shapes_match(params, expected)
+
+
 def test_sequence_loss_torch_oracle_sparse_valid():
     """Pin the sequence-loss NORMALIZATION against the official recipe with
     torch autograd, on a ~30%-valid batch (the KITTI finetune regime where

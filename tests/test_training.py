@@ -791,9 +791,10 @@ def test_kitti_training_split_devkit_naming_and_metrics(tmp_path):
 
 def test_sintel_submission_export(tmp_path):
     """--dataset sintel --split testing --dump-flow exports
-    <dstype>/<scene>/frame_XXXX.flo predictions (the official
-    create_sintel_submission layout: the render-pass level keeps clean and
-    final exports from overwriting each other), with metrics skipped."""
+    <dstype>/<scene>/frame%04d.flo predictions — byte-identical to the
+    official create_sintel_submission naming (no underscore, numbered by
+    within-scene pair index; the render-pass level keeps clean and final
+    exports from overwriting each other), with metrics skipped."""
     from raft_tpu.data.datasets import MpiSintel
     from raft_tpu.training.evaluate import evaluate_dataset
     from raft_tpu.utils import read_flo
@@ -805,7 +806,7 @@ def test_sintel_submission_export(tmp_path):
     ds = MpiSintel(str(tmp_path), "test", "clean")
     assert len(ds) == 4 and not ds.has_gt      # 2 pairs per 3-frame scene
     assert ds.dump_name(0) == os.path.join("clean", "alley_2",
-                                           "frame_0001.png")
+                                           "frame0001.png")
 
     config = RAFTConfig.small_model(iters=2)
     params = init_raft(jax.random.PRNGKey(0), config)
@@ -815,11 +816,11 @@ def test_sintel_submission_export(tmp_path):
     assert out["samples"] == 4 and "epe" not in out
     files = sorted(str(p.relative_to(sub)) for p in sub.rglob("*.flo"))
     assert files == [
-        os.path.join("clean", "alley_2", "frame_0001.flo"),
-        os.path.join("clean", "alley_2", "frame_0002.flo"),
-        os.path.join("clean", "market_4", "frame_0001.flo"),
-        os.path.join("clean", "market_4", "frame_0002.flo")], files
-    fl = read_flo(sub / "clean" / "alley_2" / "frame_0001.flo")
+        os.path.join("clean", "alley_2", "frame0001.flo"),
+        os.path.join("clean", "alley_2", "frame0002.flo"),
+        os.path.join("clean", "market_4", "frame0001.flo"),
+        os.path.join("clean", "market_4", "frame0002.flo")], files
+    fl = read_flo(sub / "clean" / "alley_2" / "frame0001.flo")
     assert fl.shape == (32, 48, 2) and np.isfinite(fl).all()
 
 

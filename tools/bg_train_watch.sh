@@ -8,7 +8,7 @@ OUT=$1; shift
 # gate on the queue's LIVE flock (held for the queue's whole run), not on a
 # persistent marker: a marker file would outlive the run and insta-kill any
 # training launched between hardware windows
-QLOCK=artifacts/hw_r4/.queue_lock
+QLOCK=artifacts/hw_r5/.queue_lock
 mkdir -p "$OUT"
 nice -n 19 python -m raft_tpu.cli -m train "$@" --out "$OUT" \
   >> "$OUT/train.log" 2>&1 &

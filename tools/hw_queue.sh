@@ -2,7 +2,7 @@
 # Unattended hardware-validation queue (VERDICT round-2 item 1).
 #
 # Runs the full capture in the mandated order the moment the TPU
-# data plane is back, logging everything under artifacts/hw_r4/.  Each
+# data plane is back, logging everything under artifacts/hw_r5/.  Each
 # stage gets its own timeout so one hang cannot eat the tunnel window;
 # stages are independent (a failed sweep still lets bench.py run).
 #
@@ -13,7 +13,7 @@
 # retires once .queue_done appears.
 set -u
 cd "$(dirname "$0")/.."
-OUT=artifacts/hw_r4
+OUT=artifacts/hw_r5
 mkdir -p "$OUT"
 exec 9>"$OUT/.queue_lock"
 flock -n 9 || { echo "hw_queue already running"; exit 0; }

@@ -13,7 +13,7 @@ LOG=${1:-/tmp/tpu_probe.log}
 # Optional absolute deadline (epoch seconds): after it, stop probing and
 # firing — the round driver needs sole TPU ownership for its own bench run.
 DEADLINE=${2:-0}
-QDIR="$(cd "$(dirname "$0")/.." && pwd)/artifacts/hw_r4"
+QDIR="$(cd "$(dirname "$0")/.." && pwd)/artifacts/hw_r5"
 mkdir -p "$QDIR"
 # The deadline file records "epoch owner_pid".  An armed loop writes its
 # deadline and removes it on exit (trap), so stale armed deadlines cannot
