@@ -1,0 +1,163 @@
+"""Official-recipe envelope check: ``python tools/envelope_check.py``.
+
+Runs the chairs-stage recipe shape ONCE, end to end — the envelope no
+prior round had executed (VERDICT r4 item 4): (368, 496) crop, global
+batch 10 fitted through gradient accumulation, 12 GRU iterations,
+freeze_bn off, per-iteration remat — and records the three numbers that
+prove the design point:
+
+1. XLA's own peak/temp memory for the compiled train step at accum 1 vs
+   accum 5 (AOT ``compile().memory_analysis()`` — the accumulation knob's
+   activation-memory reduction, measured from the compiler, not estimated);
+2. one EXECUTED optimizer step at the recipe shape (accum path exercised
+   for real) with wall time and peak host RSS;
+3. the host input-pipeline rate at the same crop (data.loader_bench),
+   sequential vs multi-process — the feed-vs-step crossover at the real
+   shape.
+
+On CPU the step time is not a TPU forecast (use tools/bench_train.py on
+hardware for that); the memory analysis and the accum/loader structure
+transfer.  Writes one JSON line per stage; run with --out to also append
+to a log file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _emit(rec, out):
+    line = json.dumps(rec)
+    print(line, flush=True)
+    if out:
+        with open(out, "a") as f:
+            f.write(line + "\n")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", type=int, nargs=2, default=(368, 496))
+    p.add_argument("--batch", type=int, default=10)      # chairs preset
+    p.add_argument("--iters", type=int, default=12)
+    p.add_argument("--accum", type=int, default=5)
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--skip-exec", action="store_true",
+                   help="memory analysis + loader only (no executed step)")
+    p.add_argument("--skip-loader", action="store_true")
+    p.add_argument("--out", default=None, metavar="FILE")
+    args = p.parse_args()
+
+    if args.cpu:
+        from _cpu_backend import force_cpu_backend
+        force_cpu_backend()
+
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.models import init_raft
+    from raft_tpu.training import (Batch, TrainState, make_optimizer,
+                                   make_train_step)
+
+    H, W = args.size
+    B = args.batch
+    config = RAFTConfig.full(iters=args.iters)        # remat_iters defaults ON
+    base = TrainConfig.for_stage("chairs", batch_size=B,
+                                 image_size=(H, W), num_steps=1000)
+    assert not base.freeze_bn                          # chairs recipe
+    dev = jax.devices()[0]
+
+    def build(accum):
+        t = dataclasses.replace(base, accum_steps=accum)
+        tx = make_optimizer(t)
+        state = TrainState.create(init_raft(jax.random.PRNGKey(0), config), tx)
+        step = jax.jit(make_train_step(config, t, tx), donate_argnums=0)
+        return t, tx, state, step
+
+    shapes = Batch(
+        image1=jax.ShapeDtypeStruct((B, H, W, 3), jnp.float32),
+        image2=jax.ShapeDtypeStruct((B, H, W, 3), jnp.float32),
+        flow=jax.ShapeDtypeStruct((B, H, W, 2), jnp.float32),
+        valid=jax.ShapeDtypeStruct((B, H, W), jnp.float32))
+
+    # -- 1. compiler-reported memory, accum 1 vs accum N ------------------
+    mem = {}
+    keep = {}                     # reuse the accum-N executable in stage 2
+    for accum in (1, args.accum):
+        _, _, state, step = build(accum)
+        t0 = time.perf_counter()
+        compiled = step.lower(
+            state, shapes, jax.ShapeDtypeStruct((2,), jnp.uint32)).compile()
+        ma = compiled.memory_analysis()
+        rec = {
+            "stage": "memory_analysis", "accum_steps": accum,
+            "backend": jax.default_backend(), "device": dev.device_kind,
+            "shape": [B, H, W], "iters": args.iters,
+            "compile_s": round(time.perf_counter() - t0, 1),
+        }
+        if ma is not None:
+            rec.update(
+                temp_mb=round(ma.temp_size_in_bytes / 2**20, 1),
+                argument_mb=round(ma.argument_size_in_bytes / 2**20, 1),
+                output_mb=round(ma.output_size_in_bytes / 2**20, 1),
+                peak_estimate_mb=round(
+                    (ma.temp_size_in_bytes + ma.argument_size_in_bytes)
+                    / 2**20, 1))
+            mem[accum] = ma.temp_size_in_bytes
+        _emit(rec, args.out)
+        if accum == args.accum:
+            keep["compiled"], keep["state"] = compiled, state
+        else:
+            del compiled, state
+        del step
+    if len(mem) == 2 and mem[args.accum] > 0:
+        _emit({"stage": "memory_ratio",
+               "temp_reduction_accum": round(mem[1] / mem[args.accum], 2),
+               "note": f"XLA temp memory, accum 1 vs {args.accum}"},
+              args.out)
+
+    # -- 2. one executed step at the recipe shape -------------------------
+    if not args.skip_exec:
+        state = keep["state"]
+        rng = np.random.RandomState(0)
+        batch = Batch(
+            image1=jnp.asarray(rng.rand(B, H, W, 3), jnp.float32),
+            image2=jnp.asarray(rng.rand(B, H, W, 3), jnp.float32),
+            flow=jnp.asarray(rng.randn(B, H, W, 2) * 4, jnp.float32),
+            valid=jnp.ones((B, H, W), jnp.float32))
+        key = jax.random.PRNGKey(1)
+        t0 = time.perf_counter()
+        state, metrics = keep["compiled"](state, batch, key)
+        loss = float(np.asarray(metrics["loss"]))
+        dt = time.perf_counter() - t0
+        _emit({"stage": "executed_step", "accum_steps": args.accum,
+               "backend": jax.default_backend(),
+               "shape": [B, H, W], "iters": args.iters,
+               "first_step_s": round(dt, 1), "loss": round(loss, 4),
+               "finite": bool(np.isfinite(loss)),
+               "peak_rss_mb": round(
+                   resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                   / 1024, 1)}, args.out)
+
+    # -- 3. host pipeline at the recipe crop ------------------------------
+    if not args.skip_loader:
+        from raft_tpu.data.loader_bench import run as loader_run
+        res = loader_run(samples=24, workers=(2, 4), crop=(H, W))
+        res["stage"] = "loader"
+        _emit(res, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,11 @@ run bench_accum    1200 python tools/bench_train.py --accum 2
 # scan_unroll was a wash on CPU (round-4 quiet-core A/B); only TPU can say
 # whether cross-iteration scheduling wins anything
 run bench_train_unroll2 1200 python tools/bench_train.py --unroll 2
+# 6. Round-5 additions: the official chairs-recipe design point (batch 10
+#    fitted via accumulation — the single-chip HBM fit the accum knob
+#    exists for), and the warm-start submission path's per-frame cost.
+run bench_train_recipe 1800 python tools/bench_train.py --batch 10 --accum 5
+run warmstart_bench    1800 python tools/warmstart_bench.py --frames 8
 if [ "$all_ok" = 1 ]; then
   date -u +%Y-%m-%dT%H:%M:%SZ > "$OUT/.queue_done"
   echo "hw_queue COMPLETE $(date -u +%H:%M:%SZ)"
