@@ -30,6 +30,18 @@ from .loss import epe_metrics
 from .step import make_eval_step
 
 
+@functools.lru_cache(maxsize=8)
+def _jitted_eval_fn(config: RAFTConfig, iters, warm: bool):
+    """Cache the jitted eval executables across evaluate_dataset calls
+    (RAFTConfig is a frozen, hashable dataclass).  Without this every call
+    builds a fresh closure with its own empty jit cache, so periodic evals
+    in the training loop — and back-to-back benchmark runs — pay a full XLA
+    recompile each time."""
+    from .step import make_warm_eval_step
+    make = make_warm_eval_step if warm else make_eval_step
+    return jax.jit(make(config, iters=iters))
+
+
 def _gt_canvas(flow_gt: np.ndarray, valid: np.ndarray, pads, hw):
     """Place unpadded ground truth into the padded prediction's canvas with
     valid=0 in the padding, so metrics can run batched on the PADDED shape:
@@ -94,7 +106,7 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
     if weighting not in ("sample", "pixel"):
         raise ValueError(f"weighting must be 'sample' or 'pixel', "
                          f"got {weighting!r}")
-    eval_fn = jax.jit(make_eval_step(config, iters=iters))
+    eval_fn = _jitted_eval_fn(config, iters, warm=False)
     # Batched, jitted metric reduction: per-sample valid-masked SUMS (vmap of
     # the same epe_metrics the per-sample path used), so a flush group costs
     # ONE device call and ONE device_get regardless of batch size — no
@@ -185,7 +197,6 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
         # construction, so batching is rejected rather than silently
         # reordered.
         from ..utils.frame_utils import forward_interpolate
-        from .step import make_warm_eval_step
         if batch_size != 1:
             raise ValueError("warm_start evaluation is sequential (frame t "
                              "seeds frame t+1): use --eval-batch 1")
@@ -193,23 +204,40 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
             raise ValueError(
                 "warm_start needs a dataset with scene structure "
                 "(is_scene_start), e.g. MpiSintel")
-        warm_fn = jax.jit(make_warm_eval_step(config, iters=iters))
-        prev_lr = None
-        for idx in range(n):
+        warm_fn = _jitted_eval_fn(config, iters, warm=True)
+
+        # The seed dependency (frame t's DEVICE output feeds frame t+1's
+        # host-side forward_interpolate) makes the compute chain strictly
+        # sequential — but frame t+1's image decode + padding is pure host
+        # IO with no dependency on t, so a one-step lookahead thread
+        # overlaps it with the device call for frame t.
+        from concurrent.futures import ThreadPoolExecutor
+
+        def _load(idx):
             im1, im2, flow_gt, valid = dataset[idx]
             im1p, pads = pad_to_multiple(im1[None], bucket, pad_mode)
             im2p, _ = pad_to_multiple(im2[None], bucket, pad_mode)
-            shapes_seen.add((1,) + im1p.shape[1:])
-            h8, w8 = im1p.shape[1] // 8, im1p.shape[2] // 8
-            if (dataset.is_scene_start(idx) or prev_lr is None
-                    or prev_lr.shape[1:3] != (h8, w8)):
-                init = np.zeros((1, h8, w8, 2), np.float32)
-            else:
-                init = forward_interpolate(prev_lr[0])[None]
-            flow_dev, lr_dev = warm_fn(params, jnp.asarray(im1p),
-                                       jnp.asarray(im2p), jnp.asarray(init))
-            prev_lr = np.asarray(lr_dev)
-            account(flow_dev, [(im1p, im2p, pads, flow_gt, valid, idx)])
+            return im1p, im2p, pads, flow_gt, valid
+
+        prev_lr = None
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            fut = pool.submit(_load, 0) if n else None
+            for idx in range(n):
+                im1p, im2p, pads, flow_gt, valid = fut.result()
+                if idx + 1 < n:
+                    fut = pool.submit(_load, idx + 1)
+                shapes_seen.add((1,) + im1p.shape[1:])
+                h8, w8 = im1p.shape[1] // 8, im1p.shape[2] // 8
+                if (dataset.is_scene_start(idx) or prev_lr is None
+                        or prev_lr.shape[1:3] != (h8, w8)):
+                    init = np.zeros((1, h8, w8, 2), np.float32)
+                else:
+                    init = forward_interpolate(prev_lr[0])[None]
+                flow_dev, lr_dev = warm_fn(params, jnp.asarray(im1p),
+                                           jnp.asarray(im2p),
+                                           jnp.asarray(init))
+                prev_lr = np.asarray(lr_dev)
+                account(flow_dev, [(im1p, im2p, pads, flow_gt, valid, idx)])
     else:
         groups: Dict[tuple, list] = {}
         for idx in range(n):

@@ -38,6 +38,7 @@ def test_candidate_config_mapping(name, impl, precision, lookup, style, p_select
     assert not cfg.small
 
 
+@pytest.mark.slow
 def test_candidate_configs_construct_valid_models():
     """Every candidate's config must pass the model's validation layer (the
     forward raises on unknown corr_lookup/corr_precision/lookup_style)."""

@@ -36,6 +36,7 @@ def test_mode_test_ctx_hoist_matches_plain(tmp_path, capsys):
     assert np.abs(a - b).max() <= 2, np.abs(a - b).max()
 
 
+@pytest.mark.slow
 def test_train_warm_start_from_checkpoint(tmp_path, capsys):
     """-m train --load warm-starts from existing weights (the official
     curriculum chains stages this way: things --load's chairs, etc.).
@@ -107,6 +108,7 @@ def test_mode_flops_reports(capsys):
     assert 0.9e6 < n < 1.1e6, n
 
 
+@pytest.mark.slow
 def test_demo_train_then_val_journey(tmp_path, capsys):
     """The flagship journey end to end: --demo-train (2 tiny steps) writes a
     checkpoint + metrics stream, then val --load <that checkpoint> evaluates
@@ -172,6 +174,7 @@ def test_val_sintel_submission_and_warm_start_flags(tmp_path, capsys):
                      "--warm-start", "--eval-batch", "4"]) == 2
 
 
+@pytest.mark.slow
 def test_mode_export_reference_npz(tmp_path, capsys):
     """-m export writes the native params npz + StableHLO, and with
     --export-reference-npz additionally the reference/tensorpack-named npz
@@ -193,3 +196,32 @@ def test_mode_export_reference_npz(tmp_path, capsys):
     assert_tree_shapes_match(b, a)
     for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_array_equal(x, y)
+
+
+def test_dtype_default_resolution(monkeypatch):
+    """--dtype default is backend- and mode-resolved: bfloat16 on TPU for
+    test/val only (measured winner, negligible EPE cost), float32 on CPU
+    and for train/export/flops; an explicit flag always wins."""
+    import argparse
+
+    import jax
+
+    def make_args(mode, dtype=None):
+        return argparse.Namespace(
+            mode=mode, dtype=dtype, corr_impl="dense", ctx_hoist=None,
+            corr_lookup=None, iters=None, small=True)
+
+    assert cli._make_config(make_args("test")).compute_dtype == "float32"
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert cli._make_config(make_args("test")).compute_dtype == "bfloat16"
+    assert cli._make_config(make_args("val")).compute_dtype == "bfloat16"
+    assert cli._make_config(make_args("train")).compute_dtype == "float32"
+    # export/flops artifacts must not change numerics with the host they
+    # happened to run on
+    assert cli._make_config(make_args("export")).compute_dtype == "float32"
+    assert cli._make_config(make_args("flops")).compute_dtype == "float32"
+    assert cli._make_config(
+        make_args("train", "bfloat16")).compute_dtype == "bfloat16"
+    assert cli._make_config(
+        make_args("test", "float32")).compute_dtype == "float32"

@@ -120,6 +120,7 @@ def test_make_fused_lookup_closure():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_model_forward_pallas_vs_dense():
     """Whole-model integration: corr_impl='pallas' output == 'dense'."""
     import dataclasses

@@ -45,6 +45,7 @@ def test_param_count_full():
     assert 0.9e6 < n_small < 1.1e6, n_small
 
 
+@pytest.mark.slow
 def test_free_batch_and_resolution():
     config = RAFTConfig.small_model(iters=2)
     params, im1, im2 = _params_and_images(config, B=2, H=48, W=64)
@@ -97,6 +98,7 @@ def test_train_mode_outputs_all_iters():
     assert not np.allclose(np.asarray(old_mean), np.asarray(new_mean))
 
 
+@pytest.mark.slow
 def test_gradients_flow_and_finite():
     config = RAFTConfig.full(iters=2)
     params, im1, im2 = _params_and_images(config, H=48, W=64)
@@ -122,6 +124,7 @@ def test_flow_init_warm_start():
     assert not np.allclose(np.asarray(out.flow), np.asarray(out0.flow))
 
 
+@pytest.mark.slow
 def test_bfloat16_compute():
     config = RAFTConfig.full(iters=2, compute_dtype="bfloat16")
     params, im1, im2 = _params_and_images(config)
@@ -164,6 +167,7 @@ def test_gru_ctx_hoist_equivalence(small):
     assert diff / scale < 1e-4, (diff, scale)
 
 
+@pytest.mark.slow
 def test_gru_ctx_hoist_gradient_equivalence():
     """The hoisted path must also produce the same parameter gradients (the
     kernel slices recombine in the cotangent)."""

@@ -33,6 +33,7 @@ def _batch(B=8, H=48, W=64, seed=0):
         valid=jnp.ones((B, H, W), jnp.float32))
 
 
+@pytest.mark.slow
 def test_dp_train_step_matches_single_device():
     config = RAFTConfig.small_model(iters=2)
     tconfig = TrainConfig(num_steps=10, lr=1e-4, schedule="constant",
@@ -60,6 +61,7 @@ def test_dp_train_step_matches_single_device():
                                    atol=5e-5, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_dp_train_step_donate_opt_out():
     """donate=False restores the pre-donation contract: the input state stays
     alive after the step (readable, no 'Array has been deleted'), and the
@@ -87,6 +89,7 @@ def test_dp_train_step_donate_opt_out():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_dp_train_step_composes_with_accumulation():
     """accum_steps inside the DP shard_map splits each DEVICE's slice: the
     update must match the plain DP step (equal valid counts, SGD)."""
@@ -329,6 +332,7 @@ def test_ring_lookup_via_fused_kernel_matches_dense():
     np.testing.assert_allclose(got, np.asarray(want), atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_shard_inference_pallas_matches_single_device():
     """Whole-model row-sharded inference with corr_impl='pallas': the ring
     pass rides the fused kernel and must match the unsharded model."""

@@ -198,6 +198,7 @@ def test_mp_loader_close_unblocks_feeder():
 
 # ------------------------------------------------------- trainability drill
 
+@pytest.mark.slow
 def test_synthetic_training_reduces_epe(tmp_path):
     """Train raft-small from scratch on procedural flow for ~70 steps: EPE
     must collapse versus the random-init value and the curve must land in

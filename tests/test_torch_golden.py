@@ -163,6 +163,7 @@ def test_small_model_torch_parity_pallas():
 
 
 @pytest.mark.parametrize("small", [True, False], ids=["small", "full"])
+@pytest.mark.slow
 def test_full_model_gradient_torch_parity(small):
     """Training-fidelity golden: gradients of the SAME scalar loss through
     the official torch model (autograd) and this framework (jax.grad) must
@@ -263,6 +264,7 @@ def test_official_state_dict_shape_contract():
     assert_tree_shapes_match(params, expected)
 
 
+@pytest.mark.slow
 def test_official_state_dict_shape_contract_small():
     """Same contract for the raft-small variant (bottleneck blocks, instance
     norms, ConvGRU): the converter must digest a REAL official-architecture

@@ -83,6 +83,7 @@ def _tiny_batch(B=2, H=48, W=64, seed=0):
         valid=jnp.ones((B, H, W), jnp.float32))
 
 
+@pytest.mark.slow
 def test_train_step_descends_and_updates():
     config = RAFTConfig.full(iters=3)
     tconfig = TrainConfig(num_steps=20, lr=1e-4, schedule="constant")
@@ -105,6 +106,7 @@ def test_train_step_descends_and_updates():
     assert not np.allclose(np.asarray(state.bn_state["cnet"]["norm1"]["mean"]), 0.0)
 
 
+@pytest.mark.slow
 def test_train_step_small_model_no_bn():
     config = RAFTConfig.small_model(iters=2)
     tconfig = TrainConfig(num_steps=10, lr=1e-4, schedule="constant")
@@ -116,6 +118,7 @@ def test_train_step_small_model_no_bn():
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_grad_accumulation_matches_full_batch():
     """accum_steps=K must produce the same update as the full batch (equal
     micro valid counts, SGD = linear in the averaged gradient), while the
@@ -148,6 +151,7 @@ def test_grad_accumulation_matches_full_batch():
             jax.tree.map(jnp.copy, state0), batch, rng)
 
 
+@pytest.mark.slow
 def test_checkpoint_roundtrip(tmp_path):
     config = RAFTConfig.small_model(iters=2)
     tconfig = TrainConfig(num_steps=10, lr=1e-4, schedule="constant")
@@ -174,6 +178,7 @@ def test_checkpoint_roundtrip(tmp_path):
         restore_checkpoint(p, other)
 
 
+@pytest.mark.slow
 def test_trained_step_improves_epe_vs_init():
     """Mini end-to-end: 30 steps on one synthetic batch should beat the
     initial EPE on that batch (overfit sanity)."""
@@ -222,6 +227,7 @@ def test_checkpoint_positional_backcompat(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_train_checkpoint_loads_for_inference(tmp_path):
     """The train->infer journey: the npz the training loop writes must load
     through the CLI's checkpoint path (params + BN stats extracted) and run
@@ -252,6 +258,7 @@ def test_train_checkpoint_loads_for_inference(tmp_path):
     assert bool(jnp.isfinite(flow).all())
 
 
+@pytest.mark.slow
 def test_restore_compat_pre_apply_if_finite_checkpoint(tmp_path):
     """Checkpoints saved before the optimizer grew the apply_if_finite
     wrapper must still restore (inner opt state recovered, fresh counters)."""
@@ -312,6 +319,7 @@ def test_checkpoint_skipped_when_params_nonfinite(tmp_path):
     assert p.exists()
 
 
+@pytest.mark.slow
 def test_metrics_stream_truncated_for_fresh_run(tmp_path):
     """A previous run that died before its first checkpoint leaves stale
     records (possibly a torn trailing line); a fresh run in the same dir must
@@ -338,6 +346,7 @@ def test_metrics_stream_truncated_for_fresh_run(tmp_path):
     assert all("epe" in r for r in records)   # no stale schema-less records
 
 
+@pytest.mark.slow
 def test_nonfinite_grads_skipped():
     """Failure containment: a poisoned batch (NaN pixels) must leave params,
     optimizer moments AND BN running stats untouched; the next clean batch
@@ -376,6 +385,7 @@ def test_nonfinite_grads_skipped():
                zip(jax.tree.leaves(before), jax.tree.leaves(changed)))
 
 
+@pytest.mark.slow
 def test_halt_on_nonfinite_loss(tmp_path):
     """Failure detection: the loop must stop with a diagnosis when the loss
     goes non-finite, not keep training a diverged model."""
@@ -415,6 +425,7 @@ class _MixedResolutionDataset:
                 np.ones((h, w), np.float32))
 
 
+@pytest.mark.slow
 def test_eval_resolution_bucketing():
     """Mixed-resolution eval must hit a bounded number of compiled shapes:
     bucketing to /16 collapses five distinct sizes onto one padded shape,
@@ -435,6 +446,7 @@ def test_eval_resolution_bucketing():
     assert out8["compiled_shapes"] >= 3, out8["compiled_shapes"]
 
 
+@pytest.mark.slow
 def test_eval_batched_matches_unbatched():
     """batch_size groups samples per device call but metrics stay per-sample:
     the numbers must be IDENTICAL to the one-at-a-time loop, both when all
@@ -531,6 +543,7 @@ class _UnequalValidDataset:
         return im1, im2, flow, valid
 
 
+@pytest.mark.slow
 def test_eval_pixel_weighting_pools_valid_pixels():
     """weighting='pixel' must match the official KITTI convention: pool the
     valid-masked sums across the whole dataset, so an image with 48x fewer
@@ -577,6 +590,7 @@ def test_eval_pixel_weighting_pools_valid_pixels():
     assert abs(out_p["epe"] - out_s["epe"]) > 1e-4, (out_p, out_s)
 
 
+@pytest.mark.slow
 def test_train_crash_resume_end_to_end(tmp_path):
     """Failure-recovery drill: train 6 steps with periodic checkpoints,
     'crash', then call train() again — it must resume from the latest
@@ -609,6 +623,7 @@ def test_train_crash_resume_end_to_end(tmp_path):
     assert all(np.isfinite(r["loss"]) for r in records)
 
 
+@pytest.mark.slow
 def test_metrics_stream_truncated_on_resume(tmp_path):
     """A crash after logging but before the next checkpoint leaves metrics
     records past the restored step; resume must drop them so the stream has
@@ -659,6 +674,7 @@ class _MixedSizeSparseValidDataset(_MixedResolutionDataset):
         return im1, im2, flow, valid
 
 
+@pytest.mark.slow
 def test_eval_batched_metrics_sparse_valid_oracle():
     """The flush-group batched metric reduction (one jitted call + one
     device_get per group, VERDICT r3 weak #6) must reproduce the per-sample
@@ -824,6 +840,7 @@ def test_sintel_submission_export(tmp_path):
     assert fl.shape == (32, 48, 2) and np.isfinite(fl).all()
 
 
+@pytest.mark.slow
 def test_freeze_bn_train_step():
     """freeze_bn=True (the official recipe for every stage after chairs)
     must leave BN running stats untouched through a train step while the
@@ -881,6 +898,7 @@ def test_freeze_bn_train_step():
         np.testing.assert_array_equal(np.asarray(b), a)
 
 
+@pytest.mark.slow
 def test_sintel_warm_start_eval(tmp_path, monkeypatch):
     """Official Sintel video protocol: within a scene each frame's low-res
     flow (forward-projected) seeds the next; scene boundaries reset.  With

@@ -80,6 +80,10 @@ run bench_train_unroll2 1200 python tools/bench_train.py --unroll 2
 #    exists for), and the warm-start submission path's per-frame cost.
 run bench_train_recipe 1800 python tools/bench_train.py --batch 10 --accum 5
 run warmstart_bench    1800 python tools/warmstart_bench.py --frames 8
+#    XLA memory_analysis of the recipe-shape train step on REAL HBM (the
+#    definitive accum-1-vs-5 fit numbers; executes only the accum-5 step).
+#    JSON lines land on stdout -> $OUT/envelope_tpu.log via run().
+run envelope_tpu       1800 python tools/envelope_check.py --skip-loader
 if [ "$all_ok" = 1 ]; then
   date -u +%Y-%m-%dT%H:%M:%SZ > "$OUT/.queue_done"
   echo "hw_queue COMPLETE $(date -u +%H:%M:%SZ)"
