@@ -152,8 +152,9 @@ def test_val_sintel_submission_and_warm_start_flags(tmp_path, capsys):
                    "--dstype", "final", "--data", str(root), "--small",
                    "--iters", "2", "--cpu", "--dump-flow", str(sub)])
     assert rc == 0
-    assert (sub / "final" / "alley_1" / "frame_0001.flo").exists()
-    assert (sub / "final" / "alley_1" / "frame_0002.flo").exists()
+    # official create_sintel_submission naming: frame%04d.flo, no underscore
+    assert (sub / "final" / "alley_1" / "frame0001.flo").exists()
+    assert (sub / "final" / "alley_1" / "frame0002.flo").exists()
 
     # warm-start protocol runs through the CLI on the training split;
     # drain captured output first so the metric assertion is scoped to
