@@ -1,6 +1,6 @@
 from .flow_io import (read_flo, read_flow_any, read_kitti_flow, read_pfm,
                       readFlow, resize_flow, write_flo, write_kitti_flow,
-                      writeFlow)
+                      write_pfm, writeFlow)
 from .flow_viz import flow_compute_color, flow_to_color, make_colorwheel
 from .frame_utils import (ReversedFlow, aug_img, calc_flow, erode_mask,
                           reverse_flow, set_static_flow)

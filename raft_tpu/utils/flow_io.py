@@ -58,6 +58,27 @@ def read_pfm(path) -> np.ndarray:
     return np.flipud(data.reshape(shape)).astype(np.float32)
 
 
+def write_pfm(arr: np.ndarray, path) -> None:
+    """Write a PFM file (color 'PF' for [H, W, 3], grayscale 'Pf' for
+    [H, W]); rows bottom-up, little-endian (scale header -1.0), per the
+    Middlebury/FlyingThings3D spec — the exact inverse of read_pfm.
+    (No scale parameter: samples are written as-is; a header scale other
+    than +/-1 would require multiplying the data for spec-compliant
+    readers, which no caller here needs.)"""
+    arr = np.asarray(arr, np.float32)
+    if arr.ndim == 3 and arr.shape[2] == 3:
+        header = b"PF"
+    elif arr.ndim == 2:
+        header = b"Pf"
+    else:
+        raise ValueError(f"PFM holds [H,W] or [H,W,3], got {arr.shape}")
+    with open(path, "wb") as f:
+        f.write(header + b"\n")
+        f.write(f"{arr.shape[1]} {arr.shape[0]}\n".encode())
+        f.write(b"-1.0\n")                     # negative = little-endian
+        np.flipud(arr).astype("<f4").tofile(f)
+
+
 def read_kitti_flow(path) -> tuple[np.ndarray, np.ndarray]:
     """KITTI 16-bit PNG flow -> ([H, W, 2] flow, [H, W] valid mask)."""
     import cv2

@@ -298,14 +298,9 @@ def test_things3d_dataset_real_layout(tmp_path):
     import cv2
 
     from raft_tpu.data.datasets import FlyingThings3D
-
-    def write_pfm_color(path, arr):                 # arr [H, W, 3] float32
-        h, w, _ = arr.shape
-        with open(path, "wb") as f:
-            f.write(b"PF\n")
-            f.write(f"{w} {h}\n".encode())
-            f.write(b"-1.0\n")                      # little-endian
-            np.flipud(arr).astype("<f4").tofile(f)
+    # the byte-level PFM format itself is pinned independently by
+    # tests/test_utils.py::test_pfm_write_read_roundtrip (hand-parsed header)
+    from raft_tpu.utils.flow_io import write_pfm as write_pfm_color
 
     rng = np.random.RandomState(3)
     n, h, w = 4, 16, 24                             # frames 0006..0009
@@ -326,7 +321,7 @@ def test_things3d_dataset_real_layout(tmp_path):
                     fl = np.zeros((h, w, 3), np.float32)
                     fl[..., 0] = i                  # marker: frame number
                     write_pfm_color(
-                        fdir / f"OpticalFlow{tag}_{i:04d}_{side}.pfm", fl)
+                        fl, fdir / f"OpticalFlow{tag}_{i:04d}_{side}.pfm")
 
     ds = FlyingThings3D(str(tmp_path))
     # 2 scenes x 2 directions x (n-1) pairs, LEFT camera only

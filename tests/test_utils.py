@@ -227,3 +227,29 @@ def test_forward_interpolate_vs_scipy_griddata_oracle():
     const = np.full((16, 24, 2), (1.5, -0.75), np.float32)
     np.testing.assert_allclose(forward_interpolate(const), official(const),
                                atol=1e-6)
+
+
+def test_pfm_write_read_roundtrip(tmp_path):
+    """write_pfm is the exact inverse of read_pfm: color and grayscale,
+    bottom-up row order, little-endian — byte-level format pinned by a
+    hand-parsed header."""
+    from raft_tpu.utils.flow_io import read_pfm, write_pfm
+
+    rng = np.random.RandomState(5)
+    color = rng.randn(7, 11, 3).astype(np.float32)
+    p = tmp_path / "c.pfm"
+    write_pfm(color, p)
+    np.testing.assert_array_equal(read_pfm(p), color)
+    with open(p, "rb") as f:
+        assert f.readline() == b"PF\n"
+        assert f.readline() == b"11 7\n"
+        assert float(f.readline()) < 0           # little-endian marker
+
+    gray = rng.randn(5, 9).astype(np.float32)
+    g = tmp_path / "g.pfm"
+    write_pfm(gray, g)
+    np.testing.assert_array_equal(read_pfm(g), gray)
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="PFM holds"):
+        write_pfm(rng.randn(4, 4, 2).astype(np.float32), tmp_path / "x.pfm")
