@@ -238,7 +238,8 @@ def _load_params(args, config):
         params = jax.tree.map(jnp.asarray, params)
         print(f"loaded checkpoint from {args.load}")
     else:
-        params = init_raft(jax.random.PRNGKey(0), config)
+        from .config import init_rng
+        params = init_raft(init_rng(), config)
         print("WARNING: no --load given; using RANDOM weights", file=sys.stderr)
     return params
 
@@ -334,8 +335,8 @@ def mode_flops(args) -> int:
     from .utils import count_params, flops_report, param_table
 
     config = _make_config(args)
-    import jax
-    params = init_raft(jax.random.PRNGKey(0), config)
+    from .config import init_rng
+    params = init_raft(init_rng(), config)
     print(param_table(params))
     print(f"trainable parameters: {count_params(params):,}")
     # the reference profiled at 1x256x448x3 (infer_raft.py:83-84)

@@ -1,0 +1,20 @@
+"""raftlint: JAX-hazard static analysis + shape/dtype contracts for raft-tpu.
+
+Two halves:
+
+* :mod:`raft_tpu.lint.engine` + :mod:`raft_tpu.lint.rules` — an AST
+  analysis suite (no jax import, scanned code is never executed) catching
+  the silent JAX failure modes that burn TPU hours: side effects and host
+  syncs under trace (R1/R6), recompilation storms (R2), PRNG misuse (R3),
+  float64 creep (R4), where-NaN gradient traps (R5), donated-buffer reuse
+  (R7), missing flow-iterate detach (R8), contract drift (R9).
+* :mod:`raft_tpu.lint.contracts` — ``@contract`` shape/dtype specs on the
+  hot-path signatures, checked statically by R9 and (opt-in) at trace time.
+
+CLI: ``python tools/raftlint.py [paths] [--strict]``.  Docs: LINT.md.
+"""
+
+from .contracts import (ContractError, checking_enabled, contract,  # noqa: F401
+                        enable_checking, parse_spec)
+from .engine import (Finding, Rule, RULES, register, scan_paths,  # noqa: F401
+                     scan_source)

@@ -1,0 +1,340 @@
+"""raftlint engine: AST-based JAX-hazard analysis with a rule registry.
+
+Pure stdlib — scanned modules are parsed, never imported, so the linter runs
+in seconds on a laptop with no jax installed.  Rules live in
+``raft_tpu/lint/rules/`` and self-register via ``@register``; each receives
+a :class:`FileContext` (parsed tree + import-alias resolution + traced-
+function analysis) and yields :class:`Finding`s.
+
+Suppression: append ``# raftlint: disable=R3`` (comma list, or ``all``) to
+the offending line, or put ``# raftlint: disable-file=R3`` on its own line
+anywhere in the file to silence a rule file-wide.
+
+The traced-context analysis is the shared backbone: a function counts as
+*traced* when jit/pmap/vmap/grad/checkpoint/custom_vjp decorate it (directly
+or through ``functools.partial``), when its name is passed to one of those
+transforms, to ``jax.lax`` control flow (scan/map/cond/while_loop/
+fori_loop/switch), to ``shard_map`` or to ``pallas_call`` — i.e. its body
+runs under a tracer, where Python side effects and host syncs are silent
+bugs (traced once, then baked into or absent from the compiled program).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+SUPPRESS_RE = re.compile(
+    r"#\s*raftlint:\s*(?P<kind>disable|disable-file)\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*|all)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule_id: str
+    severity: str            # "error" | "warning"
+    message: str
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule_id} "
+                f"[{self.severity}] {self.message}")
+
+
+class Rule:
+    """Base class: subclasses set rule_id/severity/description and implement
+    ``check(ctx) -> iterable of Finding``."""
+
+    rule_id: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "FileContext", node: ast.AST, message: str,
+                severity: Optional[str] = None) -> Finding:
+        return Finding(ctx.path, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), self.rule_id,
+                       severity or self.severity, message)
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the global registry."""
+    inst = cls()
+    assert inst.rule_id and inst.rule_id not in RULES, inst.rule_id
+    RULES[inst.rule_id] = inst
+    return cls
+
+
+# JAX entry points whose function-valued arguments run under a tracer.
+# Value = indices of the function-valued positional args.
+TRACE_ENTRIES: Dict[str, Sequence[int]] = {
+    "jax.jit": (0,), "jax.pmap": (0,), "jax.vmap": (0,), "jax.grad": (0,),
+    "jax.value_and_grad": (0,), "jax.jacfwd": (0,), "jax.jacrev": (0,),
+    "jax.checkpoint": (0,), "jax.remat": (0,), "jax.custom_vjp": (0,),
+    "jax.custom_jvp": (0,), "jax.shard_map": (0,),
+    "jax.experimental.shard_map.shard_map": (0,),
+    "jax.lax.scan": (0,), "jax.lax.map": (0,),
+    "jax.lax.while_loop": (0, 1), "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2), "jax.lax.switch": (1,),
+    "jax.experimental.pallas.pallas_call": (0,),
+}
+
+JIT_WRAPPERS = ("jax.jit", "jax.pmap")
+
+
+class FileContext:
+    """Everything a rule needs about one parsed file."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self.aliases = self._collect_aliases()
+        self.imports_jax = any(a.split(".")[0] == "jax"
+                               for a in self.aliases.values())
+        self._line_suppress, self._file_suppress = self._collect_suppressions()
+        self.functions = [n for n in ast.walk(self.tree)
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))]
+        self.traced: Dict[ast.AST, str] = self._find_traced()
+
+    # ---------------- imports / name resolution ----------------
+
+    def _collect_aliases(self) -> Dict[str, str]:
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[(a.asname or a.name.split(".")[0])] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                # level > 0 (relative) maps to the module TAIL — consumers
+                # match full canonical names or ".suffix" endings, so a
+                # tail like "lint.contracts.contract" still resolves
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted path of a Name/Attribute chain, e.g.
+        ``jnp.where`` -> ``jax.numpy.where``; None if not a plain chain."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = self.aliases.get(node.id, node.id)
+        return ".".join([head] + list(reversed(parts)))
+
+    def call_name(self, call: ast.Call) -> Optional[str]:
+        return self.resolve(call.func)
+
+    # ---------------- structure helpers ----------------
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def enclosing_functions(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                yield cur
+            cur = self._parents.get(cur)
+
+    def in_traced(self, node: ast.AST) -> Optional[str]:
+        """Reason string if ``node`` sits inside a traced function."""
+        for fn in self.enclosing_functions(node):
+            if fn in self.traced:
+                return self.traced[fn]
+        return None
+
+    def calls(self, root: Optional[ast.AST] = None) -> Iterator[ast.Call]:
+        for node in ast.walk(root if root is not None else self.tree):
+            if isinstance(node, ast.Call):
+                yield node
+
+    # ---------------- traced-function analysis ----------------
+
+    def _decorator_traces(self, dec: ast.AST) -> Optional[str]:
+        name = self.resolve(dec)
+        if name in TRACE_ENTRIES:
+            return name
+        if isinstance(dec, ast.Call):
+            fname = self.resolve(dec.func)
+            if fname in TRACE_ENTRIES:
+                return fname
+            # @functools.partial(jax.jit, ...) and friends
+            if fname in ("functools.partial", "partial") and dec.args:
+                inner = self.resolve(dec.args[0])
+                if inner in TRACE_ENTRIES:
+                    return inner
+        return None
+
+    def _find_traced(self) -> Dict[ast.AST, str]:
+        by_name: Dict[str, List[ast.AST]] = {}
+        for fn in self.functions:
+            by_name.setdefault(fn.name, []).append(fn)
+        traced: Dict[ast.AST, str] = {}
+        for fn in self.functions:
+            for dec in fn.decorator_list:
+                why = self._decorator_traces(dec)
+                if why:
+                    traced[fn] = f"@{why}"
+        for call in self.calls():
+            cname = self.call_name(call)
+            if cname not in TRACE_ENTRIES:
+                continue
+            for idx in TRACE_ENTRIES[cname]:
+                if idx >= len(call.args):
+                    continue
+                arg = call.args[idx]
+                # f passed by name, or functools.partial(f, ...)
+                names: List[str] = []
+                if isinstance(arg, ast.Name):
+                    names.append(arg.id)
+                elif isinstance(arg, ast.Call):
+                    inner = self.resolve(arg.func)
+                    if inner in ("functools.partial", "partial") and arg.args \
+                            and isinstance(arg.args[0], ast.Name):
+                        names.append(arg.args[0].id)
+                for n in names:
+                    for fn in by_name.get(n, []):
+                        traced.setdefault(fn, cname)
+        return traced
+
+    # ---------------- suppression ----------------
+
+    def _collect_suppressions(self):
+        """Only real COMMENT tokens count: a directive spelled inside a
+        docstring or string literal (e.g. documentation examples) must not
+        disable anything — otherwise any scanned file could defeat the CI
+        gate from inside a string."""
+        line_sup: Dict[int, Set[str]] = {}
+        file_sup: Set[str] = set()
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            comments = [(t.start[0], t.string) for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return line_sup, file_sup    # unparseable handled as E999 anyway
+        for lineno, text in comments:
+            m = SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            ids = {"all"} if m.group("rules") == "all" else \
+                {r.strip() for r in m.group("rules").split(",")}
+            if m.group("kind") == "disable-file":
+                file_sup |= ids
+            else:
+                line_sup.setdefault(lineno, set()).update(ids)
+        return line_sup, file_sup
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        if "all" in self._file_suppress or \
+                finding.rule_id in self._file_suppress:
+            return True
+        ids = self._line_suppress.get(finding.line, ())
+        return "all" in ids or finding.rule_id in ids
+
+
+def contract_decorator_specs(ctx: FileContext, fn: ast.AST):
+    """Yield (decorator_call, {spec_name: value_node}) for every
+    ``@contract(...)`` decorator on ``fn`` — kwargs and dict-form alike,
+    aliased imports included.  Shared by rule R9 and the CLI's
+    ``--contracts`` listing so the two can never drift apart."""
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        name = ctx.resolve(dec.func)
+        if name is None or not (name == "contract"
+                                or name.endswith(".contract")):
+            continue
+        specs: Dict[str, ast.AST] = {}
+        for kw in dec.keywords:
+            if kw.arg is not None:
+                specs[kw.arg] = kw.value
+        if dec.args and isinstance(dec.args[0], ast.Dict):
+            for k, v in zip(dec.args[0].keys, dec.args[0].values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    specs[k.value] = v
+        yield dec, specs
+
+
+def _ensure_rules_loaded() -> None:
+    if not RULES:
+        from . import rules  # noqa: F401 — registers on import
+    assert RULES, "no lint rules registered"
+
+
+def active_rules(select: Optional[Sequence[str]] = None,
+                 ignore: Optional[Sequence[str]] = None) -> List[Rule]:
+    _ensure_rules_loaded()
+    chosen = [RULES[r] for r in sorted(RULES)]
+    if select:
+        unknown = set(select) - set(RULES)
+        if unknown:
+            raise KeyError(f"unknown rule id(s) {sorted(unknown)}; "
+                           f"known: {sorted(RULES)}")
+        chosen = [r for r in chosen if r.rule_id in select]
+    if ignore:
+        chosen = [r for r in chosen if r.rule_id not in ignore]
+    return chosen
+
+
+def scan_source(source: str, path: str = "<string>",
+                select: Optional[Sequence[str]] = None,
+                ignore: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one source string; returns unsuppressed findings, sorted."""
+    try:
+        ctx = FileContext(path, source)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, e.offset or 0, "E999", "error",
+                        f"syntax error: {e.msg}")]
+    findings: List[Finding] = []
+    for rule in active_rules(select, ignore):
+        for f in rule.check(ctx):
+            if not ctx.is_suppressed(f):
+                findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule_id))
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[Path]:
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            for f in sorted(path.rglob("*.py")):
+                if not any(part.startswith(".") or part == "__pycache__"
+                           for part in f.parts):
+                    yield f
+        elif path.suffix == ".py":
+            yield path
+
+
+def scan_paths(paths: Sequence[str],
+               select: Optional[Sequence[str]] = None,
+               ignore: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint every .py file under ``paths`` (files or directories)."""
+    findings: List[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(scan_source(f.read_text(encoding="utf-8"), str(f),
+                                    select=select, ignore=ignore))
+    return findings

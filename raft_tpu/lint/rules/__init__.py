@@ -1,0 +1,7 @@
+"""Rule plugins: importing this package registers every rule with
+``engine.RULES``.  Each module holds one rule family; add a module here and
+import it below to extend the suite."""
+
+from . import (r1_side_effects, r2_recompile, r3_prng, r4_dtype,  # noqa: F401
+               r5_where_grad, r6_host_sync, r7_donation,
+               r8_stop_gradient, r9_contracts)

@@ -1,0 +1,56 @@
+"""R1: Python side effects inside traced (jit/pmap/scan/...) code.
+
+A traced function body runs ONCE, at trace time, on abstract tracers:
+``print`` fires during compilation and never again; ``.item()`` /
+``float()`` / ``int()`` / ``bool()`` on a tracer raise
+ConcretizationTypeError at runtime — or, worse, silently freeze a
+trace-time constant into the compiled program when applied to a
+non-tracer intermediate the author thought was traced.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Rule, register
+
+_SYNC_METHODS = {"item", "tolist", "numpy"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+@register
+class SideEffectsInTracedCode(Rule):
+    rule_id = "R1"
+    severity = "error"
+    description = ("Python side effect in traced code: print/.item()/"
+                   ".tolist()/float()/int()/bool() inside a jit/pmap/scan/"
+                   "grad-traced function")
+
+    def check(self, ctx: FileContext):
+        for call in ctx.calls():
+            why = ctx.in_traced(call)
+            if not why:
+                continue
+            fn = call.func
+            name = ctx.resolve(fn)
+            if name == "print":
+                yield self.finding(
+                    ctx, call,
+                    f"print() inside code traced by {why}: fires once at "
+                    f"trace time, never in the compiled program — use "
+                    f"jax.debug.print")
+            elif isinstance(fn, ast.Attribute) and fn.attr in _SYNC_METHODS \
+                    and not call.args:
+                yield self.finding(
+                    ctx, call,
+                    f".{fn.attr}() inside code traced by {why}: "
+                    f"concretizes a tracer (ConcretizationTypeError at "
+                    f"runtime)")
+            elif name in _CAST_BUILTINS and call.args and \
+                    not isinstance(call.args[0], ast.Constant):
+                yield self.finding(
+                    ctx, call,
+                    f"{name}() on a traced value inside code traced by "
+                    f"{why}: concretizes a tracer — keep it a jnp array "
+                    f"(or hoist the Python scalar out of the traced "
+                    f"function)")

@@ -1,0 +1,133 @@
+"""R2: recompilation hazards around ``jax.jit`` / ``jax.pmap``.
+
+Three concrete shapes of the same storm:
+
+* ``jax.jit(f)`` constructed inside a loop body — every iteration builds a
+  fresh wrapper with an empty cache, so every iteration recompiles.
+* ``jax.jit(f)(x)`` immediate invocation — same thing spelled on one line.
+* a parameter of a jitted function used as a SHAPE (``jnp.zeros(n)``,
+  ``x.reshape(n, -1)``) without being listed in ``static_argnums``/
+  ``static_argnames`` — traced shapes must be static, so this either
+  errors at trace time or, once the author "fixes" it by passing Python
+  ints, retraces on every distinct value without the cache keying the
+  author expects.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from ..engine import FileContext, JIT_WRAPPERS, Rule, register
+
+_SHAPE_TAKING = {
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.full", "jax.numpy.empty",
+    "jax.numpy.arange", "jax.numpy.eye", "jax.numpy.broadcast_to",
+    "numpy.zeros", "numpy.ones", "numpy.full", "numpy.empty",
+}
+
+
+def _static_names(call: ast.Call, fn: ast.FunctionDef) -> Optional[Set[str]]:
+    """Names covered by static_argnums/static_argnames in a jit call over
+    ``fn``; None when unresolvable (give the benefit of the doubt)."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) \
+            else [kw.value]
+        for v in vals:
+            if not isinstance(v, ast.Constant):
+                return None
+            if isinstance(v.value, int) and v.value < len(params):
+                out.add(params[v.value])
+            elif isinstance(v.value, str):
+                out.add(v.value)
+    return out
+
+
+@register
+class RecompilationHazard(Rule):
+    rule_id = "R2"
+    severity = "error"
+    description = ("recompilation hazard: jit built in a loop, jit(f)(x) "
+                   "immediate invocation, or a shape-bearing Python arg "
+                   "missing from static_argnames")
+
+    def check(self, ctx: FileContext):
+        for call in ctx.calls():
+            name = ctx.call_name(call)
+            if name not in JIT_WRAPPERS:
+                continue
+            # (a) jit(...) constructed inside a for/while body
+            node, inside_loop = call, False
+            while node is not None:
+                parent = ctx.parent(node)
+                if isinstance(parent, (ast.For, ast.While)) \
+                        and node is not parent.iter:
+                    inside_loop = True
+                    break
+                if isinstance(parent, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.Lambda)):
+                    break
+                node = parent
+            if inside_loop:
+                yield self.finding(
+                    ctx, call,
+                    f"{name}() constructed inside a loop: each iteration "
+                    f"gets a fresh compilation cache and recompiles — hoist "
+                    f"the jitted function out of the loop")
+            # (b) jax.jit(f)(x): fresh wrapper per call site execution
+            parent = ctx.parent(call)
+            if isinstance(parent, ast.Call) and parent.func is call:
+                yield self.finding(
+                    ctx, call,
+                    f"{name}(f)(...) immediate invocation: the wrapper (and "
+                    f"its cache) is rebuilt every time this line runs — "
+                    f"bind `g = {name}(f)` once and call g")
+
+        # (c) shape-bearing params of decorated-jitted defs not marked static
+        for fn in ctx.functions:
+            jit_dec = None
+            for dec in fn.decorator_list:
+                dname = ctx.resolve(dec)
+                dcall = dec if isinstance(dec, ast.Call) else None
+                if dcall is not None:
+                    dname = ctx.resolve(dcall.func)
+                    if dname in ("functools.partial", "partial") \
+                            and dcall.args:
+                        inner = ctx.resolve(dcall.args[0])
+                        if inner in JIT_WRAPPERS:
+                            jit_dec = dcall
+                            break
+                if dname in JIT_WRAPPERS:
+                    jit_dec = dcall if dcall is not None else dec
+                    break
+            if jit_dec is None:
+                continue
+            static = _static_names(jit_dec, fn) \
+                if isinstance(jit_dec, ast.Call) else set()
+            if static is None:
+                continue
+            params = {a.arg for a in fn.args.posonlyargs + fn.args.args
+                      + fn.args.kwonlyargs} - static
+            for call in ctx.calls(fn):
+                cname = ctx.call_name(call)
+                shapeish = []
+                if cname in _SHAPE_TAKING and call.args:
+                    shapeish.append(call.args[0])
+                cf = call.func
+                if isinstance(cf, ast.Attribute) and cf.attr == "reshape":
+                    shapeish.extend(call.args)
+                for arg in shapeish:
+                    names = [n.id for n in ast.walk(arg)
+                             if isinstance(n, ast.Name)]
+                    hits = [n for n in names if n in params]
+                    if hits:
+                        yield self.finding(
+                            ctx, call,
+                            f"parameter {hits[0]!r} of jitted "
+                            f"{fn.name}() used as a shape: shapes must be "
+                            f"static under jit — add "
+                            f"static_argnames=({hits[0]!r},)")

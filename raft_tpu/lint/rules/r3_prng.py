@@ -1,0 +1,104 @@
+"""R3: PRNG key hygiene — hardcoded seeds and key reuse.
+
+``jax.random.PRNGKey(0)`` scattered across call sites means every one of
+those paths draws the SAME stream (the augmentation pipeline and the
+weight init silently correlate); a key passed to two sampling calls
+without an intervening ``split`` draws identical numbers twice.  Keys are
+consumed, not reused — one seeded source (``config.init_rng``), split
+everywhere else.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict
+
+from ..engine import FileContext, Rule, register
+
+_KEY_MAKERS = {"jax.random.PRNGKey", "jax.random.key"}
+
+# first positional arg is a consumed key
+_KEY_CONSUMERS = {
+    f"jax.random.{f}" for f in (
+        "split", "normal", "uniform", "bernoulli", "randint", "permutation",
+        "shuffle", "categorical", "choice", "gumbel", "truncated_normal",
+        "exponential", "laplace", "dirichlet", "beta", "gamma", "poisson",
+        "bits", "rademacher")
+}
+
+
+@register
+class PRNGHygiene(Rule):
+    rule_id = "R3"
+    severity = "error"
+    description = ("PRNG hazard: hardcoded PRNGKey(<literal>) outside the "
+                   "sanctioned init helper, or a key consumed twice without "
+                   "an intervening split")
+
+    def check(self, ctx: FileContext):
+        for call in ctx.calls():
+            name = ctx.call_name(call)
+            if name in _KEY_MAKERS and call.args and \
+                    isinstance(call.args[0], ast.Constant) and \
+                    isinstance(call.args[0].value, int):
+                yield self.finding(
+                    ctx, call,
+                    f"hardcoded {name.split('.')[-1]}"
+                    f"({call.args[0].value}): every call site seeded this "
+                    f"way draws the SAME stream — take the key from one "
+                    f"seeded init helper (raft_tpu.config.init_rng) and "
+                    f"split from it")
+        for fn in ctx.functions:
+            yield from self._check_reuse(ctx, fn)
+
+    def _check_reuse(self, ctx: FileContext, fn):
+        """Statement-order scan of one function's own body (nested defs are
+        their own scope): a name consumed by a jax.random call is poisoned
+        until it is reassigned.  Within one statement consumption precedes
+        binding (Python evaluates the RHS first), so
+        ``key, sub = jax.random.split(key)`` consumes the old key and then
+        rebinds it fresh — no false positive, and the pattern the message
+        recommends stays clean."""
+
+        def stmt_of(node: ast.AST) -> ast.AST:
+            cur = node
+            while cur is not None and not isinstance(cur, ast.stmt):
+                cur = ctx.parent(cur)
+            return cur if cur is not None else node
+
+        events = []          # (stmt_line, stmt_col, rank, seq, kind, name, node)
+        for seq, node in enumerate(ast.walk(fn)):
+            owner = next(ctx.enclosing_functions(node), None)
+            if owner is not fn:
+                continue
+            stmt = stmt_of(node)
+            key = (stmt.lineno, stmt.col_offset)
+            if isinstance(node, ast.Call) and \
+                    ctx.call_name(node) in _KEY_CONSUMERS and node.args and \
+                    isinstance(node.args[0], ast.Name):
+                events.append((*key, 0, seq, "consume",
+                               node.args[0].id, node))
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.For):
+                targets = [node.target]
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        events.append((*key, 1, seq, "assign", n.id, node))
+        consumed: Dict[str, ast.AST] = {}
+        for *_sort, kind, name, node in sorted(events, key=lambda e: e[:4]):
+            if kind == "assign":
+                consumed.pop(name, None)
+            elif name in consumed:
+                yield self.finding(
+                    ctx, node,
+                    f"PRNG key {name!r} reused: already consumed by the "
+                    f"jax.random call at line {consumed[name].lineno} — "
+                    f"split first (`{name}, sub = jax.random."
+                    f"split({name})`)")
+            else:
+                consumed[name] = node

@@ -1,0 +1,78 @@
+"""R4: dtype discipline — float64 creep and astype churn in jax code.
+
+On TPU float64 is emulated (when enabled at all); a single ``dtype=float``
+or ``jnp.float64`` in a jax expression either errors under the default
+x64-disabled config or silently doubles memory and halves throughput on
+CPU where it IS honored.  Chained ``.astype().astype()`` round-trips are
+the quiet version: each hop can round (f32->bf16->f32 loses mantissa) and
+none of them is annotated with intent — collapse to one cast, or state the
+intended dtype with a lint contract.
+
+Scope: only modules that import jax — host-side numpy code (visualization,
+file IO) legitimately uses float64.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Rule, register
+
+_F64_NAMES = {"jax.numpy.float64", "numpy.float64", "float",
+              "jax.numpy.double", "numpy.double"}
+
+
+def _is_f64(ctx: FileContext, node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "float64":
+        return True
+    return ctx.resolve(node) in _F64_NAMES
+
+
+@register
+class DtypeDiscipline(Rule):
+    rule_id = "R4"
+    severity = "error"
+    description = ("dtype hazard: float64 dtype in jax code, or a chained "
+                   ".astype().astype() round-trip")
+
+    def check(self, ctx: FileContext):
+        if not ctx.imports_jax:
+            return
+        for call in ctx.calls():
+            name = ctx.call_name(call)
+            # (a) jnp call with a float64-ish dtype (positional or keyword)
+            if name and name.startswith("jax.numpy."):
+                culprit = None
+                for kw in call.keywords:
+                    if kw.arg == "dtype" and _is_f64(ctx, kw.value):
+                        culprit = kw.value
+                for arg in call.args[1:]:
+                    if _is_f64(ctx, arg):
+                        culprit = arg
+                if culprit is not None:
+                    yield self.finding(
+                        ctx, call,
+                        f"float64 dtype passed to {name}: promotes to f64 "
+                        f"(emulated/disabled on TPU; silent 2x memory on "
+                        f"CPU) — use jnp.float32, or an explicit f64 "
+                        f"contract if intended")
+            fn = call.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "astype":
+                # (b) .astype(float64-ish)
+                if call.args and _is_f64(ctx, call.args[0]):
+                    yield self.finding(
+                        ctx, call,
+                        "astype to float64 in a jax module: accidental "
+                        "promotion — state the intended dtype "
+                        "(jnp.float32?) or move host-side math to a "
+                        "non-jax module")
+                # (c) x.astype(a).astype(b) chain
+                inner = fn.value
+                if isinstance(inner, ast.Call) and \
+                        isinstance(inner.func, ast.Attribute) and \
+                        inner.func.attr == "astype":
+                    yield self.finding(
+                        ctx, call,
+                        "chained .astype().astype(): each hop can round "
+                        "(f32->bf16->f32 loses mantissa bits) — collapse "
+                        "to a single cast and annotate the intended dtype")

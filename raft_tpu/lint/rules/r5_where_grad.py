@@ -1,0 +1,96 @@
+"""R5: ``jnp.where`` NaN-gradient traps.
+
+``jnp.where(ok, unsafe, fallback)`` evaluates AND differentiates BOTH
+branches: if the unsafe branch divides, sqrt-s, logs or norms something
+that is 0/negative exactly where ``ok`` is False, the forward value is
+fine but the backward pass multiplies ``0 * NaN = NaN`` and poisons every
+gradient upstream.  The fix is the double-where trick: sanitize the
+operand first (``safe = jnp.where(ok, x, 1.0)``) and only then apply the
+unsafe op inside the outer where.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Rule, register
+
+_WHERE = {"jax.numpy.where", "jax.lax.select"}
+_UNSAFE_CALLS = {
+    "jax.numpy.sqrt", "jax.numpy.log", "jax.numpy.log2", "jax.numpy.log10",
+    "jax.numpy.log1p", "jax.numpy.divide", "jax.numpy.true_divide",
+    "jax.numpy.arcsin", "jax.numpy.arccos", "jax.numpy.arctanh",
+    "jax.numpy.power", "jax.numpy.float_power", "jax.numpy.reciprocal",
+    "jax.numpy.linalg.norm", "jax.lax.rsqrt", "jax.lax.sqrt", "jax.lax.log",
+}
+
+
+# wrapping the hazardous operand in one of these makes it safe (the
+# double-where trick and its jnp.maximum/jnp.clip cousins)
+_SANITIZERS = {"jax.numpy.where", "jax.numpy.maximum", "jax.numpy.clip",
+               "jax.lax.select", "jax.lax.max", "jax.lax.clamp"}
+
+
+def _is_sanitized(ctx: FileContext, node: ast.AST, sanitized_names) -> bool:
+    if isinstance(node, ast.Name) and node.id in sanitized_names:
+        return True
+    if isinstance(node, ast.Call) and ctx.call_name(node) in _SANITIZERS:
+        return True
+    return False
+
+
+def _unsafe_reason(ctx: FileContext, branch: ast.AST, sanitized_names):
+    for node in ast.walk(branch):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div) \
+                and not isinstance(node.right, ast.Constant) \
+                and not _is_sanitized(ctx, node.right, sanitized_names):
+            return "a division"
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Pow) and \
+                not (isinstance(node.right, ast.Constant)
+                     and isinstance(node.right.value, int)):
+            return "a fractional power"
+        if isinstance(node, ast.Call):
+            name = ctx.call_name(node)
+            if name in _UNSAFE_CALLS and not (
+                    node.args and _is_sanitized(ctx, node.args[0],
+                                                sanitized_names)):
+                return f"{name.split('.')[-1]}()"
+    return None
+
+
+@register
+class WhereGradTrap(Rule):
+    rule_id = "R5"
+    severity = "error"
+    description = ("jnp.where with an unsafe branch (division/sqrt/log/"
+                   "norm): both branches are differentiated, 0*NaN poisons "
+                   "the gradient — use the double-where trick")
+
+    def check(self, ctx: FileContext):
+        # names assigned from a sanitizer call anywhere in the enclosing
+        # scope count as safe operands (flow-insensitive, lenient on purpose)
+        sanitized = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call) \
+                    and ctx.call_name(node.value) in _SANITIZERS:
+                scope = next(ctx.enclosing_functions(node), None)
+                sanitized.setdefault(scope, set()).add(node.targets[0].id)
+        for call in ctx.calls():
+            if ctx.call_name(call) not in _WHERE or len(call.args) != 3:
+                continue
+            scope = next(ctx.enclosing_functions(call), None)
+            safe_names = sanitized.get(scope, set()) | sanitized.get(None,
+                                                                     set())
+            for branch in call.args[1:]:
+                reason = _unsafe_reason(ctx, branch, safe_names)
+                if reason:
+                    yield self.finding(
+                        ctx, call,
+                        f"jnp.where branch contains {reason}: both branches "
+                        f"are evaluated AND differentiated, so NaN/inf from "
+                        f"the untaken branch reaches the gradient (0*NaN = "
+                        f"NaN) — sanitize the operand with an inner "
+                        f"jnp.where first (double-where trick)")
+                    break

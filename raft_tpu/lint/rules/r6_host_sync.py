@@ -1,0 +1,54 @@
+"""R6: host sync points inside traced code.
+
+``jax.device_get`` / ``np.asarray`` / ``.block_until_ready()`` force a
+device->host transfer.  Inside a traced function they either raise
+(TracerArrayConversionError) or — when the value happens to be concrete at
+trace time — silently bake a stale constant into the compiled step.  In
+the training step this is the classic throughput killer: one host sync per
+step serializes the whole TPU pipeline behind PCIe.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Rule, register
+
+_HOST_CALLS = {"jax.device_get", "jax.device_put"}
+
+
+@register
+class HostSyncInTracedCode(Rule):
+    rule_id = "R6"
+    severity = "error"
+    description = ("host sync inside traced code: jax.device_get / "
+                   "numpy call on a tracer / .block_until_ready() forces a "
+                   "device->host round trip (or bakes a constant)")
+
+    def check(self, ctx: FileContext):
+        for call in ctx.calls():
+            why = ctx.in_traced(call)
+            if not why:
+                continue
+            name = ctx.call_name(call)
+            if name in _HOST_CALLS:
+                yield self.finding(
+                    ctx, call,
+                    f"{name} inside code traced by {why}: device<->host "
+                    f"transfer in a compiled step — return the value and "
+                    f"transfer outside, or use jax.debug.callback")
+            elif name and name.split(".")[0] == "numpy":
+                yield self.finding(
+                    ctx, call,
+                    f"{name} inside code traced by {why}: numpy on a "
+                    f"tracer concretizes it (host sync / trace-time "
+                    f"constant) — use the jax.numpy equivalent")
+            else:
+                fn = call.func
+                if isinstance(fn, ast.Attribute) and \
+                        fn.attr == "block_until_ready":
+                    yield self.finding(
+                        ctx, call,
+                        f".block_until_ready() inside code traced by "
+                        f"{why}: meaningless on tracers and a pipeline "
+                        f"stall outside — sync at the call site instead")

@@ -1,0 +1,148 @@
+"""R7: donated-buffer misuse.
+
+``jax.jit(f, donate_argnums=0)`` hands the input buffer to XLA for in-place
+reuse: the Python-side array is DELETED the moment the call dispatches.
+Reading it afterwards raises "Array has been deleted" — but only on the
+paths that actually execute, so the bug ships.  The contract is
+rebind-and-forget: ``state = step(state, ...)``.  This rule flags a donated
+argument that is read again after the call without being rebound.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..engine import FileContext, JIT_WRAPPERS, Rule, register
+
+_OWN_SCOPE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+              ast.ClassDef)
+
+
+def _donated_positions(call: ast.Call) -> Optional[Set[int]]:
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) \
+            else [kw.value]
+        out = set()
+        for v in vals:
+            if not (isinstance(v, ast.Constant) and isinstance(v.value, int)):
+                return None              # dynamic — give benefit of the doubt
+            out.add(v.value)
+        return out
+    return None
+
+
+def _names(node: ast.AST, ctx_type) -> Iterator[ast.Name]:
+    """Name nodes of the given ctx under ``node``, not crossing scopes."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _OWN_SCOPE) and n is not node:
+            continue
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ctx_type):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class _Scanner:
+    """Linear, statement-ordered scan of one function body.  Each simple
+    unit processes: (1) flag loads of dead names, (2) apply donations,
+    (3) apply rebinds — so a donate-and-rebind statement leaves its
+    argument alive, while a read in any LATER statement fires."""
+
+    def __init__(self, rule: "DonatedBufferMisuse", ctx: FileContext,
+                 donating: Dict[str, Set[int]]):
+        self.rule, self.ctx, self.donating = rule, ctx, donating
+        self.dead: Dict[str, ast.Call] = {}
+        self.findings: List = []
+
+    def unit(self, node: Optional[ast.AST],
+             stores: Tuple[ast.AST, ...] = ()) -> None:
+        if node is not None:
+            for n in _names(node, ast.Load):
+                if n.id in self.dead:
+                    call = self.dead.pop(n.id)    # one finding per donation
+                    self.findings.append(self.rule.finding(
+                        self.ctx, n,
+                        f"{n.id!r} was donated to the jitted call at line "
+                        f"{call.lineno} (donate_argnums) and is read again "
+                        f"here: the buffer is deleted at dispatch — rebind "
+                        f"the result (`{n.id} = step({n.id}, ...)`), or "
+                        f"drop the donation"))
+            for call in ast.walk(node):
+                if isinstance(call, ast.Call) \
+                        and isinstance(call.func, ast.Name) \
+                        and call.func.id in self.donating:
+                    for i, a in enumerate(call.args):
+                        if i in self.donating[call.func.id] \
+                                and isinstance(a, ast.Name):
+                            self.dead[a.id] = call
+        for t in stores:
+            for n in _names(t, (ast.Store, ast.Load)):
+                self.dead.pop(n.id, None)
+
+    def run(self, stmts) -> None:
+        for s in stmts:
+            if isinstance(s, _OWN_SCOPE):
+                continue
+            elif isinstance(s, ast.Assign):
+                self.unit(s.value, tuple(s.targets))
+            elif isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+                self.unit(s.value, (s.target,))
+            elif isinstance(s, (ast.For, ast.AsyncFor)):
+                self.unit(s.iter, (s.target,))
+                self.run(s.body)
+                self.run(s.orelse)
+            elif isinstance(s, ast.While):
+                self.unit(s.test)
+                self.run(s.body)
+                self.run(s.orelse)
+            elif isinstance(s, ast.If):
+                self.unit(s.test)
+                self.run(s.body)
+                self.run(s.orelse)
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                for item in s.items:
+                    self.unit(item.context_expr,
+                              (item.optional_vars,) if item.optional_vars
+                              else ())
+                self.run(s.body)
+            elif isinstance(s, ast.Try):
+                self.run(s.body)
+                for h in s.handlers:
+                    self.run(h.body)
+                self.run(s.orelse)
+                self.run(s.finalbody)
+            else:
+                self.unit(s)
+
+
+@register
+class DonatedBufferMisuse(Rule):
+    rule_id = "R7"
+    severity = "error"
+    description = ("donated buffer reused: an argument donated via "
+                   "donate_argnums is read after the call without being "
+                   "rebound — 'Array has been deleted' at runtime")
+
+    def check(self, ctx: FileContext):
+        donating: Dict[str, Set[int]] = {}
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and ctx.call_name(node.value) in JIT_WRAPPERS):
+                continue
+            pos = _donated_positions(node.value)
+            if not pos:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    donating[t.id] = pos
+        if not donating:
+            return
+        for fn in ctx.functions:
+            scanner = _Scanner(self, ctx, donating)
+            scanner.run(fn.body)
+            yield from scanner.findings

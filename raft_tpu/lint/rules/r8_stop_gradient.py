@@ -1,0 +1,66 @@
+"""R8: missing ``stop_gradient`` on iterative flow/coords updates.
+
+RAFT's recurrence refines ``coords1 = coords1 + delta_flow`` inside a
+``lax.scan``.  Official RAFT (and the reference, RAFT.py:93) DETACHES the
+incoming coordinates each iteration — without it, gradients flow through
+the whole coordinate chain AND through the correlation-lookup indices,
+which both blows up memory for long unrolls and trains a subtly different
+(and less stable) objective.  This rule flags a scan body that additively
+updates a flow/coords-named carry without any ``stop_gradient`` in sight.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import FileContext, Rule, register
+
+_ITERATE_NAME = re.compile(r"^(coords?|flow)\w*$")
+_STOP_GRAD = {"jax.lax.stop_gradient", "jax.numpy.stop_gradient"}
+_SCAN_ENTRIES = {"jax.lax.scan", "jax.lax.fori_loop", "jax.lax.while_loop"}
+
+
+@register
+class MissingStopGradient(Rule):
+    rule_id = "R8"
+    severity = "error"
+    description = ("iterative flow/coords update inside a scan body without "
+                   "stop_gradient: gradients flow through every iteration's "
+                   "coordinate chain (official RAFT detaches, RAFT.py:93)")
+
+    def check(self, ctx: FileContext):
+        scan_bodies = {fn for fn in ctx.functions
+                       if ctx.traced.get(fn) in _SCAN_ENTRIES}
+        for fn in scan_bodies:
+            has_stop = any(
+                isinstance(n, ast.Call)
+                and ctx.call_name(n) in _STOP_GRAD
+                for n in ast.walk(fn))
+            if has_stop:
+                continue
+            for node in ast.walk(fn):
+                target_name = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.BinOp) \
+                        and isinstance(node.value.op, ast.Add):
+                    t = node.targets[0].id
+                    operands = [node.value.left, node.value.right]
+                    if any(isinstance(o, ast.Name) and o.id == t
+                           for o in operands):
+                        target_name = t
+                elif isinstance(node, ast.AugAssign) \
+                        and isinstance(node.op, ast.Add) \
+                        and isinstance(node.target, ast.Name):
+                    target_name = node.target.id
+                if target_name and _ITERATE_NAME.match(target_name):
+                    yield self.finding(
+                        ctx, node,
+                        f"scan body {fn.name}() updates {target_name!r} "
+                        f"additively with no stop_gradient anywhere in the "
+                        f"body: the flow iterate should be detached each "
+                        f"iteration (coords = jax.lax.stop_gradient("
+                        f"coords)) — official RAFT semantics, and the "
+                        f"backward memory grows with the full iteration "
+                        f"chain otherwise")

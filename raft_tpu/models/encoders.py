@@ -196,5 +196,7 @@ def apply_encoder(p: dict, x: jax.Array, norm_fn: str, small: bool = False,
         # channel dropout (torch nn.Dropout2d): zero whole channels per sample
         keep = 1.0 - dropout
         mask = jax.random.bernoulli(rng, keep, (y.shape[0], 1, 1, y.shape[-1]))
-        y = jnp.where(mask, y / keep, 0.0)
+        # divide AFTER the select: identical values, and no division inside
+        # a jnp.where branch (raftlint R5 — both branches are differentiated)
+        y = jnp.where(mask, y, 0.0) / keep
     return y, p

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import RAFTConfig
+from ..lint.contracts import contract
 from ..ops import spmd
 from ..ops.coords import coords_grid, upflow8
 from ..ops.corr import (build_pyramid, fmap2_pyramid, lookup_blockwise_onehot,
@@ -70,6 +71,8 @@ def _preprocess(image: jax.Array, config: RAFTConfig) -> jax.Array:
     return x
 
 
+@contract(image1="*[B,H,W,3]", image2="*[B,H,W,3]",
+          flow_init="*[B,HL,WL,2]")
 def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                  config: RAFTConfig, iters: Optional[int] = None,
                  train: bool = False, axis_name: Optional[str] = None,

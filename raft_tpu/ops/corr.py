@@ -33,6 +33,7 @@ from typing import List, Sequence
 import jax
 import jax.numpy as jnp
 
+from ..lint.contracts import contract
 from .conv import avg_pool2d
 
 
@@ -44,6 +45,8 @@ def fmap2_pyramid(fmap2: jax.Array, num_levels: int = 4) -> List[jax.Array]:
     return levels
 
 
+@contract(fmap1="*[B,H,W,C]", fmap2_l="*[B,H2,W2,C]",
+          _returns="f32[B,Q,H2,W2]")
 def dense_corr(fmap1: jax.Array, fmap2_l: jax.Array,
                precision=None) -> jax.Array:
     """[B, H1, W1, C] x [B, H2, W2, C] -> [B, H1*W1, H2, W2] scaled corr."""
@@ -105,6 +108,7 @@ def _bilinear_window(winv: jax.Array, fx: jax.Array, fy: jax.Array, r: int) -> j
     return out.transpose(0, 1, 3, 2).reshape(*out.shape[:2], n * n)
 
 
+@contract(coords="*[B,H,W,2]", _returns="f32[B,H,W,N]")
 def lookup_dense(pyramid: Sequence[jax.Array], coords: jax.Array, radius: int) -> jax.Array:
     """Sample the dense pyramid at ``coords`` [B, H, W, 2] (x, y).
 
@@ -143,6 +147,7 @@ def _onehot_interp(idx0: jax.Array, frac: jax.Array, n: int, size: int,
             + jnp.where(ids == tgt + 1, f, 0.0))
 
 
+@contract(corr3="f32[B,Q,HB,W]", coords="*[B,Q,2]", _returns="f32[B,Q,N]")
 def lookup_partial_onehot(corr3: jax.Array, coords: jax.Array, radius: int,
                           level: int, row_offset: int | jax.Array = 0) -> jax.Array:
     """Window lookup on a (possibly row-partial) correlation plane, as two
@@ -174,6 +179,7 @@ def lookup_partial_onehot(corr3: jax.Array, coords: jax.Array, radius: int,
     return win.reshape(B, Q, n * n)
 
 
+@contract(coords="*[B,H,W,2]", _returns="f32[B,H,W,N]")
 def lookup_dense_onehot(pyramid: Sequence[jax.Array], coords: jax.Array,
                         radius: int) -> jax.Array:
     """Drop-in alternative to ``lookup_dense`` using the one-hot matmul
@@ -205,6 +211,7 @@ def _gather_feature_windows(fmap: jax.Array, ix0: jax.Array, iy0: jax.Array, win
     return cols  # [B, T, win(y), win(x), C]
 
 
+@contract(fmap1="*[B,H,W,C]", coords="*[B,H,W,2]", _returns="f32[B,H,W,N]")
 def lookup_ondemand(fmap1: jax.Array, fmap2_levels: Sequence[jax.Array],
                     coords: jax.Array, radius: int, chunk: int = 1024,
                     precision=None) -> jax.Array:
@@ -253,6 +260,7 @@ def lookup_ondemand(fmap1: jax.Array, fmap2_levels: Sequence[jax.Array],
     return out.reshape(B, H, W, -1)
 
 
+@contract(fmap1="*[B,H,W,C]", coords="*[B,H,W,2]", _returns="f32[B,H,W,N]")
 def lookup_blockwise_onehot(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
                             coords: jax.Array, radius: int,
                             chunk: int = 512, precision=None) -> jax.Array:

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..lint.contracts import contract
 from .corr import fmap2_pyramid, lookup_blockwise_onehot
 
 
@@ -365,6 +366,13 @@ def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
     return out[:, :Q] if Qp != Q else out
 
 
+# Dtype audit (raftlint R4 / contracts): the kernel is float32 END TO END —
+# inputs are cast at _lookup_level, the corr matmul accumulates f32
+# (preferred_element_type), and every scale factor (corr_scale, level_scale)
+# is a weak-typed Python float, so nothing promotes to f64 even under
+# jax_enable_x64 on the CPU backend.  The contract pins that intent.
+@contract(fmap1="f32[B,H,W,C]", coords="f32[B,H,W,2]",
+          _returns="f32[B,H,W,N]")
 def _fused_lookup_impl(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
                        coords: jax.Array, radius: int,
                        q_blk: int = 128, p_blk_target: int = 4096,
@@ -443,6 +451,7 @@ def _fused_lookup_bwd(radius, corr_precision, q_blk, p_blk_target,
 fused_lookup.defvjp(_fused_lookup_fwd, _fused_lookup_bwd)
 
 
+@contract(fmap1="*[B,H,W,C]", fmap2="*[B,H2,W2,C]")
 def make_fused_lookup(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
                       radius: int, corr_precision="highest",
                       q_blk: int = 128, p_blk_target: int = 4096,

@@ -18,12 +18,15 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..lint.contracts import contract
+
 
 def _gather_pixels(img_flat: jax.Array, idx: jax.Array) -> jax.Array:
     """img_flat: [B, H*W, C]; idx: int32 [B, N] -> [B, N, C]."""
     return jnp.take_along_axis(img_flat, idx[..., None], axis=1)
 
 
+@contract(img="*[B,H,W,C]", coords="*[B,...,2]", _returns="*[B,...,C]")
 def grid_sample(img: jax.Array, coords: jax.Array, padding_mode: str = "zeros") -> jax.Array:
     """Sample ``img`` bilinearly at pixel coordinates ``coords``.
 
@@ -81,6 +84,7 @@ def grid_sample(img: jax.Array, coords: jax.Array, padding_mode: str = "zeros") 
     return out.reshape(out_shape)
 
 
+@contract(img="*[B,H,W,C]", grid="*[B,...,2]", _returns="*[B,...,C]")
 def grid_sample_normalized(img: jax.Array, grid: jax.Array, padding_mode: str = "zeros",
                            align_corners: bool = True) -> jax.Array:
     """PyTorch-convention entry point: ``grid`` in [-1, 1], last axis (x, y)."""

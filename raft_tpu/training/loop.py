@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import RAFTConfig, TrainConfig
+from ..config import RAFTConfig, TrainConfig, init_rng
 from ..models import init_raft
 from .checkpoint import (latest_checkpoint, restore_checkpoint_compat,
                          save_checkpoint)
@@ -42,14 +42,13 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
     """
     tx = make_optimizer(tconfig)
     if init_params is None:
-        init_params = init_raft(jax.random.PRNGKey(tconfig.seed), config)
+        init_params = init_raft(init_rng(tconfig.seed), config)
     else:
         # fail with a clear message on a checkpoint/config mismatch (e.g.
         # full-model weights with --small) instead of a cryptic trace error
         # in the first jitted step
         from ..convert import assert_tree_shapes_match
-        assert_tree_shapes_match(
-            init_params, init_raft(jax.random.PRNGKey(0), config))
+        assert_tree_shapes_match(init_params, init_raft(init_rng(), config))
         init_params = jax.tree.map(jnp.asarray, init_params)
     state = TrainState.create(init_params, tx)
 

@@ -13,7 +13,10 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..lint.contracts import contract
 
+
+@contract(flow_preds="*[I,B,H,W,2]", flow_gt="*[B,H,W,2]", valid="*[B,H,W]")
 def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array,
                   valid: Optional[jax.Array] = None, gamma: float = 0.8,
                   max_flow: float = 400.0,

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import optax
 
 from ..config import RAFTConfig, TrainConfig
+from ..lint.contracts import contract
 from ..models.raft import raft_forward
 from .loss import sequence_loss
 from .state import TrainState, merge_bn_state, split_bn_state
@@ -61,6 +62,8 @@ def make_train_step(config: RAFTConfig, tconfig: TrainConfig,
 
     accum = tconfig.accum_steps
 
+    @contract({"batch.image1": "*[B,H,W,3]", "batch.image2": "*[B,H,W,3]",
+               "batch.flow": "*[B,H,W,2]", "batch.valid": "*[B,H,W]"})
     def train_step(state: TrainState, batch: Batch, rng: jax.Array):
         if accum <= 1:
             grads, (new_bn, metrics) = grad_fn(state.params, state.bn_state,
@@ -117,6 +120,8 @@ def make_train_step(config: RAFTConfig, tconfig: TrainConfig,
 def make_eval_step(config: RAFTConfig, iters: Optional[int] = None):
     """Returns step(params, image1, image2) -> final full-res flow."""
 
+    @contract(image1="*[B,H,W,3]", image2="*[B,H,W,3]",
+              _returns="*[B,H,W,2]")
     def eval_step(params, image1, image2):
         out, _ = raft_forward(params, image1, image2, config, iters=iters,
                               train=False, all_flows=False)
@@ -133,6 +138,8 @@ def make_warm_eval_step(config: RAFTConfig, iters: Optional[int] = None):
     flow is forward-projected (utils.frame_utils.forward_interpolate) to
     seed the next frame of the same scene."""
 
+    @contract(image1="*[B,H,W,3]", image2="*[B,H,W,3]",
+              flow_init="*[B,HL,WL,2]")
     def eval_step(params, image1, image2, flow_init):
         out, _ = raft_forward(params, image1, image2, config, iters=iters,
                               train=False, all_flows=False,

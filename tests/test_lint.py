@@ -1,0 +1,531 @@
+"""raftlint suite tests: every rule R1-R9 fires on a seeded bad fixture and
+is silenced by ``# raftlint: disable=RX``; good twins stay clean; the
+shape/dtype contract machinery parses, enforces, and reports; and the repo
+itself scans clean under --strict (the CI gate, marked ``lint``).
+
+No jax import is needed for the engine tests — the linter is pure AST.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+from typing import NamedTuple
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from raft_tpu.lint import contracts  # noqa: E402
+from raft_tpu.lint.engine import (RULES, active_rules, scan_paths,  # noqa: E402
+                                  scan_source)
+
+
+def ids(findings):
+    return {f.rule_id for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# (rule_id, bad fixture, good twin) — the bad one MUST fire exactly that
+# rule; the good twin must not.  Suppression is tested programmatically by
+# appending the disable comment to every flagged line of the bad fixture.
+# ---------------------------------------------------------------------------
+
+FIXTURES = [
+    ("R1", """
+import jax
+
+@jax.jit
+def f(x):
+    print("value is", x)
+    return x * 2
+""", """
+import jax
+
+@jax.jit
+def f(x):
+    jax.debug.print("value is {}", x)
+    return x * 2
+"""),
+    ("R1", """
+import jax
+
+@jax.jit
+def f(x):
+    return float(x) * 2
+""", """
+import jax
+
+@jax.jit
+def f(x):
+    return x.astype("float32") * 2
+"""),
+    ("R1", """
+import jax
+
+def body(carry, x):
+    s = carry + x.item()
+    return s, s
+
+def run(xs):
+    import jax.numpy as jnp
+    return jax.lax.scan(body, jnp.float32(0), xs)
+""", """
+import jax
+
+def body(carry, x):
+    s = carry + x
+    return s, s
+
+def run(xs):
+    import jax.numpy as jnp
+    return jax.lax.scan(body, jnp.float32(0), xs)
+"""),
+    ("R2", """
+import jax
+
+def run(fn, batches):
+    out = []
+    for b in batches:
+        out.append(jax.jit(fn)(b))
+    return out
+""", """
+import jax
+
+def run(fn, batches):
+    jfn = jax.jit(fn)
+    out = []
+    for b in batches:
+        out.append(jfn(b))
+    return out
+"""),
+    ("R2", """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def make_mask(n):
+    return jnp.zeros(n)
+""", """
+import functools
+
+import jax
+import jax.numpy as jnp
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def make_mask(n):
+    return jnp.zeros(n)
+"""),
+    ("R3", """
+import jax
+
+def load_params(path):
+    return jax.random.PRNGKey(0)
+""", """
+import jax
+
+def load_params(path, seed):
+    return jax.random.PRNGKey(seed)
+"""),
+    ("R3", """
+import jax
+
+def augment(key, img):
+    a = jax.random.normal(key, img.shape)
+    b = jax.random.uniform(key, img.shape)
+    return img + a * b
+""", """
+import jax
+
+def augment(key, img):
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, img.shape)
+    b = jax.random.uniform(key, img.shape)
+    return img + a * b
+"""),
+    ("R4", """
+import jax.numpy as jnp
+
+def zeros_like_flow(h, w):
+    return jnp.zeros((h, w, 2), dtype=jnp.float64)
+""", """
+import jax.numpy as jnp
+
+def zeros_like_flow(h, w):
+    return jnp.zeros((h, w, 2), dtype=jnp.float32)
+"""),
+    ("R4", """
+import jax.numpy as jnp
+
+def roundtrip(x):
+    return x.astype(jnp.bfloat16).astype(jnp.float32)
+""", """
+import jax.numpy as jnp
+
+def single_cast(x):
+    return x.astype(jnp.float32)
+"""),
+    ("R5", """
+import jax.numpy as jnp
+
+def normalize(flow, mag):
+    return jnp.where(mag > 0, flow / mag, 0.0)
+""", """
+import jax.numpy as jnp
+
+def normalize(flow, mag):
+    safe = jnp.where(mag > 0, mag, 1.0)
+    return jnp.where(mag > 0, flow / safe, 0.0)
+"""),
+    ("R6", """
+import jax
+import numpy as np
+
+@jax.jit
+def step(state, batch):
+    loss = np.asarray(state).mean()
+    return state, loss
+""", """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(state, batch):
+    loss = jnp.asarray(state).mean()
+    return state, loss
+"""),
+    ("R6", """
+import jax
+
+@jax.jit
+def step(state):
+    return jax.device_get(state)
+""", """
+import jax
+
+@jax.jit
+def step(state):
+    return state
+
+def log(state):
+    return jax.device_get(state)
+"""),
+    ("R7", """
+import jax
+
+def train(make_step, state, batches):
+    step = jax.jit(make_step, donate_argnums=0)
+    for b in batches:
+        new_state, metrics = step(state, b)
+    return state
+""", """
+import jax
+
+def train(make_step, state, batches):
+    step = jax.jit(make_step, donate_argnums=0)
+    for b in batches:
+        state, metrics = step(state, b)
+    return state
+"""),
+    ("R8", """
+import jax
+
+def unroll(coords, deltas):
+    def body(carry, d):
+        coords = carry
+        coords = coords + d
+        return coords, coords
+    return jax.lax.scan(body, coords, deltas)
+""", """
+import jax
+
+def unroll(coords, deltas):
+    def body(carry, d):
+        coords = jax.lax.stop_gradient(carry)
+        coords = coords + d
+        return coords, coords
+    return jax.lax.scan(body, coords, deltas)
+"""),
+    ("R9", """
+from raft_tpu.lint.contracts import contract
+
+@contract(x="f32[B,H,")
+def f(x):
+    return x
+""", """
+from raft_tpu.lint.contracts import contract
+
+@contract(x="f32[B,H,W,2]")
+def f(x):
+    return x
+"""),
+    ("R9", """
+from raft_tpu.lint.contracts import contract
+
+@contract(coords="f32[B,2]")
+def f(x):
+    return x
+""", """
+from raft_tpu.lint.contracts import contract
+
+@contract(x="f32[B,2]")
+def f(x, radius=1):
+    return x
+"""),
+]
+
+
+@pytest.mark.parametrize("rule_id,bad,good",
+                         FIXTURES, ids=[f"{r}-{i}" for i, (r, _, _)
+                                        in enumerate(FIXTURES)])
+def test_rule_fires_and_good_twin_clean(rule_id, bad, good):
+    bad_findings = scan_source(bad)
+    assert rule_id in ids(bad_findings), \
+        f"{rule_id} did not fire on its bad fixture"
+    assert rule_id not in ids(scan_source(good)), \
+        f"{rule_id} fired on its good twin"
+
+
+@pytest.mark.parametrize("rule_id,bad,good",
+                         FIXTURES, ids=[f"{r}-{i}" for i, (r, _, _)
+                                        in enumerate(FIXTURES)])
+def test_suppression_comment_silences(rule_id, bad, good):
+    findings = [f for f in scan_source(bad) if f.rule_id == rule_id]
+    assert findings
+    lines = bad.splitlines()
+    for f in findings:
+        lines[f.line - 1] += f"  # raftlint: disable={rule_id}"
+    assert rule_id not in ids(scan_source("\n".join(lines)))
+
+
+def test_suppress_all_and_file_level():
+    bad = FIXTURES[0][1]
+    findings = scan_source(bad)
+    line = findings[0].line
+    lines = bad.splitlines()
+    lines[line - 1] += "  # raftlint: disable=all"
+    assert not scan_source("\n".join(lines))
+    assert not scan_source("# raftlint: disable-file=R1\n" + bad)
+
+
+def test_directive_inside_string_literal_does_not_suppress():
+    # a disable directive spelled in a docstring/string must NOT defeat the
+    # gate — only real comment tokens count
+    bad = FIXTURES[0][1]
+    assert "R1" in ids(scan_source(
+        '"""docs say: # raftlint: disable-file=R1"""\n' + bad))
+    assert "R1" in ids(scan_source(
+        "x = '# raftlint: disable=all'\n" + bad))
+
+
+def test_aliased_contract_import_still_checked_by_r9():
+    src = """
+from raft_tpu.lint.contracts import contract as shape_spec
+
+@shape_spec(coords="f32[B,")
+def f(coords):
+    return coords
+"""
+    assert "R9" in ids(scan_source(src))
+
+
+def test_eight_plus_distinct_rules_covered():
+    active_rules()
+    covered = {r for r, _, _ in FIXTURES}
+    assert len(covered) >= 8
+    assert covered == set(RULES), \
+        "every registered rule needs a bad/good fixture pair"
+
+
+def test_select_and_ignore():
+    bad = FIXTURES[0][1]
+    assert ids(scan_source(bad, select=["R3"])) == set()
+    assert "R1" not in ids(scan_source(bad, ignore=["R1"]))
+    with pytest.raises(KeyError):
+        active_rules(select=["R99"])
+
+
+def test_syntax_error_is_reported_not_raised():
+    findings = scan_source("def broken(:\n  pass")
+    assert [f.rule_id for f in findings] == ["E999"]
+
+
+def test_alias_resolution_variants():
+    src = """
+from jax import numpy as weird
+from jax.random import PRNGKey as mk
+
+def f():
+    k = mk(0)
+    return weird.zeros((3,), dtype=weird.float64)
+"""
+    got = ids(scan_source(src))
+    assert "R3" in got and "R4" in got
+
+
+# ---------------------------------------------------------------------------
+# contracts
+# ---------------------------------------------------------------------------
+
+def test_parse_spec_accepts_and_rejects():
+    s = contracts.parse_spec("bf16|f32[B,...,2]")
+    assert s.dtypes == ("bfloat16", "float32")
+    assert s.dims == ("B", "...", 2)
+    for bad in ("f32[B", "q99[B]", "f32[b]", "f32[...,...]", "[B,?]"):
+        with pytest.raises(contracts.ContractError):
+            contracts.parse_spec(bad)
+
+
+def test_contract_rejects_unknown_parameter_at_decoration():
+    with pytest.raises(contracts.ContractError):
+        @contracts.contract(nope="f32[B]")
+        def f(x):
+            return x
+
+
+@pytest.fixture
+def checked():
+    contracts.enable_checking(True)
+    yield
+    contracts.enable_checking(False)
+
+
+def test_contract_runtime_checks(checked):
+    import numpy as np
+
+    @contracts.contract(a="f32[B,N]", b="f32[B,N]", _returns="f32[B,N]")
+    def add(a, b):
+        return a + b
+
+    x = np.zeros((2, 3), np.float32)
+    assert add(x, x).shape == (2, 3)
+    with pytest.raises(contracts.ContractError, match="B=2"):
+        add(x, np.zeros((4, 3), np.float32))      # inconsistent symbol
+    with pytest.raises(contracts.ContractError, match="dtype"):
+        add(x, np.zeros((2, 3), np.float64))
+    with pytest.raises(contracts.ContractError, match="rank"):
+        add(x, np.zeros((2, 3, 1), np.float32))
+
+
+def test_contract_dotted_and_none_and_disabled():
+    import numpy as np
+
+    class Batch(NamedTuple):
+        image: object
+        flow: object
+
+    @contracts.contract({"batch.image": "f32[B,H,W,3]",
+                         "batch.flow": "f32[B,H,W,2]"}, extra="f32[B]")
+    def step(batch, extra=None):
+        return batch.image
+
+    good = Batch(np.zeros((1, 8, 8, 3), np.float32),
+                 np.zeros((1, 8, 8, 2), np.float32))
+    bad = Batch(np.zeros((1, 8, 8, 3), np.float32),
+                np.zeros((2, 8, 8, 2), np.float32))
+    contracts.enable_checking(False)
+    step(bad)                                      # disabled -> passes through
+    contracts.enable_checking(True)
+    try:
+        step(good)                                 # None extra is skipped
+        with pytest.raises(contracts.ContractError):
+            step(bad)
+    finally:
+        contracts.enable_checking(False)
+
+
+def test_dotted_contract_on_missing_field_raises(checked):
+    import numpy as np
+
+    class Batch(NamedTuple):
+        image: object
+
+    @contracts.contract({"batch.imgae": "f32[B,H,W,3]"})   # typo'd on purpose
+    def step(batch):
+        return batch.image
+
+    with pytest.raises(contracts.ContractError, match="no such field"):
+        step(Batch(np.zeros((1, 4, 4, 3), np.float32)))
+
+
+def test_env_var_parsed_tolerantly():
+    for val, expect in (("true", "True"), ("1", "True"), ("YES", "True"),
+                        ("0", "False"), ("nonsense", "False"), ("", "False")):
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "from raft_tpu.lint import contracts; "
+             "print(contracts.checking_enabled())"],
+            capture_output=True, text=True, cwd=str(REPO),
+            env={**__import__('os').environ,
+                 "RAFT_TPU_CHECK_CONTRACTS": val})
+        assert r.returncode == 0, r.stderr
+        assert r.stdout.strip() == expect, (val, r.stdout, r.stderr)
+
+
+def test_contracts_survive_jit_tracing():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    @contracts.contract(x="f32[B,N]", _returns="f32[B,N]")
+    def double(x):
+        return x * 2
+
+    contracts.enable_checking(True)
+    try:
+        out = jax.jit(double)(jnp.ones((2, 5), jnp.float32))
+        assert out.shape == (2, 5)
+        with pytest.raises(contracts.ContractError):
+            jax.jit(double)(jnp.ones((2, 5), jnp.bfloat16))
+    finally:
+        contracts.enable_checking(False)
+
+
+def test_fused_kernel_contract_pins_float32():
+    """Satellite audit (ops/corr_pallas.py): the fused lookup is f32 end to
+    end on the CPU (interpret) backend — enforced by its contract."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.corr import fmap2_pyramid
+    from raft_tpu.ops.corr_pallas import _fused_lookup_impl
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+    f1 = jax.random.normal(k1, (1, 8, 8, 16), jnp.float32)
+    f2 = jax.random.normal(k2, (1, 8, 8, 16), jnp.float32)
+    coords = jnp.zeros((1, 8, 8, 2), jnp.float32) + 3.5
+    contracts.enable_checking(True)
+    try:
+        out = _fused_lookup_impl(f1, fmap2_pyramid(f2, 2), coords, 2)
+        assert out.dtype == jnp.float32
+        with pytest.raises(contracts.ContractError):
+            _fused_lookup_impl(f1.astype(jnp.bfloat16),
+                               fmap2_pyramid(f2, 2), coords, 2)
+    finally:
+        contracts.enable_checking(False)
+
+
+# ---------------------------------------------------------------------------
+# the repo itself
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint
+def test_self_scan_repo_is_clean():
+    findings = scan_paths([str(REPO / "raft_tpu")])
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+@pytest.mark.lint
+def test_cli_strict_exits_zero_on_repo_and_one_on_bad_file(tmp_path):
+    r = subprocess.run([sys.executable, str(REPO / "tools" / "raftlint.py"),
+                        str(REPO / "raft_tpu"), "--strict"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\nk = jax.random.PRNGKey(0)\n")
+    r = subprocess.run([sys.executable, str(REPO / "tools" / "raftlint.py"),
+                        str(bad), "--strict"],
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "R3" in r.stdout

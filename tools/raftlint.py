@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""raftlint CLI: scan the package for JAX hazards (see LINT.md).
+
+    python tools/raftlint.py                    # scan raft_tpu/, report
+    python tools/raftlint.py --strict           # exit 1 on ANY finding (CI)
+    python tools/raftlint.py path/to/file.py --select R3,R7
+    python tools/raftlint.py --list-rules
+    python tools/raftlint.py --contracts        # dump @contract'd signatures
+
+Pure stdlib + AST: nothing is imported or executed from the scanned tree,
+so this runs in well under a second with or without jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from raft_tpu.lint import engine  # noqa: E402
+
+
+def _list_rules() -> None:
+    engine.active_rules()
+    for rid in sorted(engine.RULES):
+        rule = engine.RULES[rid]
+        print(f"{rid}  [{rule.severity}]  {rule.description}")
+
+
+def _dump_contracts(paths) -> None:
+    # rides the same FileContext + contract_decorator_specs helper as lint
+    # rule R9, so the listing and the validity check can never disagree on
+    # what counts as a contract (aliased imports included)
+    for f in engine.iter_python_files(paths):
+        ctx = engine.FileContext(str(f), f.read_text(encoding="utf-8"))
+        for node in ctx.functions:
+            for _dec, specs in engine.contract_decorator_specs(ctx, node):
+                rendered = {k: getattr(v, "value", "?")
+                            for k, v in specs.items()}
+                print(f"{f}:{node.lineno}: {node.name}  "
+                      + "  ".join(f"{k}={v!r}"
+                                  for k, v in rendered.items()))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="raftlint", description="JAX-hazard static analysis for raft-tpu")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to scan (default: raft_tpu/)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit non-zero on any finding (CI gate); default "
+                        "mode is report-only")
+    p.add_argument("--select", default=None, metavar="R1,R2",
+                   help="run only these rule ids")
+    p.add_argument("--ignore", default=None, metavar="R4",
+                   help="skip these rule ids")
+    p.add_argument("--format", default="text", choices=["text", "json"])
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--contracts", action="store_true",
+                   help="list every @contract'd signature instead of linting")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        _list_rules()
+        return 0
+    paths = args.paths or [str(REPO_ROOT / "raft_tpu")]
+    if args.contracts:
+        _dump_contracts(paths)
+        return 0
+    select = args.select.split(",") if args.select else None
+    ignore = args.ignore.split(",") if args.ignore else None
+    try:
+        findings = engine.scan_paths(paths, select=select, ignore=ignore)
+    except KeyError as e:
+        print(f"ERROR: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        errors = sum(f.severity == "error" for f in findings)
+        warnings = len(findings) - errors
+        n_files = len(list(engine.iter_python_files(paths)))
+        print(f"raftlint: {n_files} files scanned, {errors} error(s), "
+              f"{warnings} warning(s)"
+              + (" [strict]" if args.strict else ""))
+    if args.strict and findings:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
