@@ -30,8 +30,11 @@ def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="raft_tpu",
                                 description="TPU-native RAFT optical flow")
     p.add_argument("-m", "--mode", default="test",
-                   choices=["train", "val", "test", "export", "flops"],
-                   help="run mode (reference infer_raft.py:57-58 surface)")
+                   choices=["train", "val", "test", "export", "flops",
+                            "serve"],
+                   help="run mode (reference infer_raft.py:57-58 surface; "
+                        "'serve' starts the long-lived micro-batching "
+                        "inference server — SERVING.md)")
     p.add_argument("--im1", default="assets/frame_0016.png", help="left image")
     p.add_argument("--im2", default="assets/frame_0017.png", help="right image")
     p.add_argument("--load", default=None,
@@ -191,6 +194,39 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="multi-host train: total process count")
     p.add_argument("--process-id", type=int, default=None,
                    help="multi-host train: this process's rank")
+    # serve mode (SERVING.md): every device shape is declared here, up
+    # front — the engine AOT-compiles the (bucket x batch-step) grid before
+    # accepting traffic, so steady-state serving never recompiles
+    p.add_argument("--host", default="127.0.0.1",
+                   help="serve mode: bind address")
+    p.add_argument("--port", type=int, default=8000,
+                   help="serve mode: bind port (0 = ephemeral, printed)")
+    p.add_argument("--buckets", default="432x1024", metavar="HxW,HxW",
+                   help="serve mode: pre-declared resolution buckets; each "
+                        "request pads to the smallest fitting bucket "
+                        "(sides must be multiples of 8)")
+    p.add_argument("--max-batch", type=int, default=4,
+                   help="serve mode: micro-batcher coalescing cap (batch 4 "
+                        "measured 27.47 vs 21.12 pairs/s solo — PERF.md)")
+    p.add_argument("--max-wait-ms", type=float, default=5.0,
+                   help="serve mode: max time the oldest queued request "
+                        "waits for batch-mates before a partial flush")
+    p.add_argument("--queue-depth", type=int, default=128,
+                   help="serve mode: admission-queue bound; submissions "
+                        "beyond it are shed with 429 (backpressure) "
+                        "instead of queueing unboundedly")
+    p.add_argument("--deadline-ms", type=float, default=2000.0,
+                   help="serve mode: default per-request deadline; a "
+                        "request still queued past it returns 504 "
+                        "(clients can lower per call, never raise)")
+    p.add_argument("--serve-dp", type=int, default=None, metavar="N",
+                   help="serve mode: shard each device batch over N local "
+                        "devices (parallel.make_dp_eval_fn); batch steps "
+                        "are rounded up to multiples of N")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="serve mode: skip the AOT warmup of the "
+                        "(bucket x batch-step) compile grid (first "
+                        "request per shape then pays its compile)")
     return p
 
 
@@ -209,7 +245,18 @@ def _make_config(args):
         # they happened to run on
         import jax
         dtype = ("bfloat16" if jax.default_backend() == "tpu"
-                 and args.mode in ("test", "val") else "float32")
+                 and args.mode in ("test", "val", "serve") else "float32")
+        if (dtype == "bfloat16" and args.mode == "val"
+                and getattr(args, "split", None) == "testing"
+                and getattr(args, "dump_flow", None)):
+            # ADVICE r5: submission artifacts (server-uploadable .flo/PNG)
+            # must not silently vary with the host backend — same contract
+            # as export/flops above.  Pin float32; --dtype bfloat16 still
+            # opts in explicitly.
+            dtype = "float32"
+            print("[val] testing-split submission export: pinning float32 "
+                  "(artifacts must not vary with the host backend; pass "
+                  "--dtype bfloat16 to override)")
     overrides = dict(corr_impl=args.corr_impl, compute_dtype=dtype)
     if args.ctx_hoist is not None:       # tri-state: None = config default
         overrides["gru_ctx_hoist"] = args.ctx_hoist
@@ -387,6 +434,11 @@ def mode_train(args) -> int:
     return train_cli(args, _make_config(args))
 
 
+def mode_serve(args) -> int:
+    from .serving.server import serve_cli
+    return serve_cli(args, _make_config(args), _load_params)
+
+
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     if args.demo_train:
@@ -416,7 +468,8 @@ def main(argv=None) -> int:
                    num_processes=args.num_processes,
                    process_id=args.process_id)
     return {"test": mode_test, "flops": mode_flops, "export": mode_export,
-            "val": mode_val, "train": mode_train}[args.mode](args)
+            "val": mode_val, "train": mode_train,
+            "serve": mode_serve}[args.mode](args)
 
 
 if __name__ == "__main__":

@@ -17,15 +17,8 @@ import jax
 import numpy as np
 
 
-def pad_to_multiple(image: np.ndarray, multiple: int = 8,
-                    mode: str = "sintel") -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
-    """Replicate-pad [..., H, W, C] so H, W divide ``multiple``.
-
-    mode 'sintel': split padding between both sides; 'kitti': pad top/right
-    only.  Returns (padded, (top, bottom, left, right)) for unpad_flow."""
-    h, w = image.shape[-3], image.shape[-2]
-    ph = (-h) % multiple
-    pw = (-w) % multiple
+def _apply_pads(image: np.ndarray, ph: int, pw: int,
+                mode: str) -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
     if mode == "sintel":
         pads = (ph // 2, ph - ph // 2, pw // 2, pw - pw // 2)
     else:
@@ -33,6 +26,29 @@ def pad_to_multiple(image: np.ndarray, multiple: int = 8,
     t, b, l, r = pads
     width = [(0, 0)] * (image.ndim - 3) + [(t, b), (l, r), (0, 0)]
     return np.pad(image, width, mode="edge"), pads
+
+
+def pad_to_multiple(image: np.ndarray, multiple: int = 8,
+                    mode: str = "sintel") -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
+    """Replicate-pad [..., H, W, C] so H, W divide ``multiple``.
+
+    mode 'sintel': split padding between both sides; 'kitti': pad top/right
+    only.  Returns (padded, (top, bottom, left, right)) for unpad_flow."""
+    h, w = image.shape[-3], image.shape[-2]
+    return _apply_pads(image, (-h) % multiple, (-w) % multiple, mode)
+
+
+def pad_to_shape(image: np.ndarray, target_hw: Tuple[int, int],
+                 mode: str = "sintel") -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
+    """Replicate-pad [..., H, W, C] up to an exact (H, W) — the serving
+    resolution-bucket variant of :func:`pad_to_multiple` (same replicate
+    semantics and pads tuple, so :func:`unpad` inverts both).  Raises when
+    the image exceeds the target."""
+    h, w = image.shape[-3], image.shape[-2]
+    th, tw = target_hw
+    if h > th or w > tw:
+        raise ValueError(f"image ({h}, {w}) exceeds pad target ({th}, {tw})")
+    return _apply_pads(image, th - h, tw - w, mode)
 
 
 def unpad(arr: np.ndarray, pads: Tuple[int, int, int, int]) -> np.ndarray:

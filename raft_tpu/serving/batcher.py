@@ -1,0 +1,113 @@
+"""Dynamic micro-batcher: coalesce, pad, execute, deliver.
+
+One daemon thread pulls same-bucket FIFO runs from the admission queue
+(``RequestQueue.take_batch``: full batch, aged ``max_wait_ms``, or drain —
+whichever first), pads the group up to the next declared batch step by
+repeating the last pair (any filler works — per-sample inference is
+independent; repetition keeps values finite for the instance norms), runs
+the warm engine, slices real rows back out, unpads each to its request's
+original resolution, and resolves the waiting handler threads.
+
+The engine is injected as a callable ``run(bucket, im1, im2) -> flow`` so
+tests can drive the batching policy with a stub (slow / counting / failing)
+engine and never touch a compile.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..data.pipeline import unpad
+from .queue import DeadlineExceeded, RequestQueue
+
+
+class MicroBatcher:
+    def __init__(self, queue: RequestQueue, run_fn: Callable,
+                 pad_batch_to: Callable[[int], int], max_batch: int,
+                 max_wait_ms: float, metrics: Optional[Dict] = None):
+        self.queue = queue
+        self.run_fn = run_fn
+        self.pad_batch_to = pad_batch_to
+        self.max_batch = max_batch
+        self.max_wait = max_wait_ms / 1000.0
+        self.metrics = metrics or {}
+        self.batches = 0
+        self.served = 0
+        self.timed_out = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="raft-serving-batcher")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def _observe(self, name: str, *args) -> None:
+        m = self.metrics.get(name)
+        if m is None:
+            return
+        if args and hasattr(m, "observe"):
+            m.observe(args[0])
+        elif hasattr(m, "labels") and len(args) == 2:
+            m.labels(args[0]).inc(args[1])
+        elif hasattr(m, "inc"):
+            m.inc(*args)
+
+    def _fail_expired(self, expired) -> None:
+        for r in expired:
+            self.timed_out += 1
+            self._observe("requests", "timeout", 1)
+            r.fail(DeadlineExceeded(
+                f"deadline exceeded after "
+                f"{time.monotonic() - r.enqueued_at:.3f}s in queue"))
+
+    def _execute(self, batch) -> None:
+        n = len(batch)
+        padded = self.pad_batch_to(min(n, self.max_batch))
+        im1 = np.concatenate([r.image1 for r in batch]
+                             + [batch[-1].image1] * (padded - n))
+        im2 = np.concatenate([r.image2 for r in batch]
+                             + [batch[-1].image2] * (padded - n))
+        self._observe("batch_size", float(n))
+        self._observe("batch_occupancy", n / padded)
+        self._observe("inflight", 1)
+        t0 = time.monotonic()
+        try:
+            flows = self.run_fn(batch[0].bucket, im1, im2)
+        except BaseException as e:
+            for r in batch:
+                self._observe("requests", "error", 1)
+                r.fail(e)
+            return
+        finally:
+            self._observe("inflight", -1)
+            self._observe("batch_latency", time.monotonic() - t0)
+        now = time.monotonic()
+        for i, r in enumerate(batch):
+            r.batch_real, r.batch_padded = n, padded
+            self._observe("queue_latency", r.dequeued_at - r.enqueued_at)
+            self._observe("request_latency", now - r.enqueued_at)
+            self._observe("requests", "ok", 1)
+            self.served += 1
+            r.resolve(unpad(flows[i:i + 1], r.pads)[0])
+        self._observe("pairs", float(n))
+
+    def _loop(self) -> None:
+        while True:
+            batch, expired = self.queue.take_batch(self.max_batch,
+                                                   self.max_wait)
+            self._fail_expired(expired)
+            if batch is None:        # queue closed and empty: drained
+                return
+            if batch:
+                self.batches += 1
+                self._execute(batch)

@@ -1,0 +1,134 @@
+"""Serving configuration: the knobs of the micro-batching inference service.
+
+Every shape the server will ever put on the device is declared HERE, up
+front: the resolution buckets and the batch steps.  The engine warms (AOT-
+compiles) the full (bucket x batch-step) grid before the first request, so
+steady-state serving never traces or compiles — the raftlint R2 discipline
+(no recompile storms) enforced structurally rather than by convention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+
+def parse_buckets(spec: str) -> Tuple[Tuple[int, int], ...]:
+    """Parse a CLI bucket spec like ``"432x1024,240x432"`` into an (H, W)
+    tuple list.  Each side must be a positive multiple of 8 (the RAFT
+    stride contract, models/raft.py)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            h, w = (int(v) for v in part.lower().split("x"))
+        except ValueError:
+            raise ValueError(f"bad bucket {part!r}: expected HxW, e.g. 432x1024")
+        if h <= 0 or w <= 0 or h % 8 or w % 8:
+            raise ValueError(f"bucket {part!r}: H and W must be positive "
+                             f"multiples of 8")
+        out.append((h, w))
+    if not out:
+        raise ValueError(f"no buckets in spec {spec!r}")
+    return tuple(out)
+
+
+def default_batch_steps(max_batch: int) -> Tuple[int, ...]:
+    """Powers of two up to (and always including) max_batch: every padded
+    device call hits one of these sizes, so the compile grid stays
+    O(log max_batch) per bucket instead of O(max_batch)."""
+    steps = []
+    s = 1
+    while s < max_batch:
+        steps.append(s)
+        s *= 2
+    steps.append(max_batch)
+    return tuple(steps)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Static configuration of the serving stack (see SERVING.md)."""
+
+    # Pre-declared resolution buckets, largest-wins routing NOT — each
+    # request routes to the SMALLEST bucket that contains it (minimal
+    # padding); inputs larger than every bucket are rejected with 400.
+    buckets: Tuple[Tuple[int, int], ...] = ((432, 1024),)
+    # Micro-batcher: coalesce same-bucket requests up to max_batch, or until
+    # the oldest queued request has waited max_wait_ms — whichever first.
+    max_batch: int = 4
+    max_wait_ms: float = 5.0
+    # Batch sizes actually compiled/executed; a coalesced group is padded up
+    # to the next step (occupancy = real / padded).  None = powers of two
+    # up to max_batch (default_batch_steps).
+    batch_steps: Tuple[int, ...] = None  # type: ignore[assignment]
+    # Admission control: at most this many requests WAITING (in-flight
+    # batches excluded); submissions beyond it are shed with 429 instead of
+    # queueing unboundedly.
+    queue_depth: int = 128
+    # Per-request deadline (client can lower per call, never raise): a
+    # request still queued past its deadline is dropped with 504 — late
+    # answers are worthless and computing them steals capacity.
+    default_deadline_ms: float = 2000.0
+    # HTTP endpoint. port 0 = ephemeral (the bound port is printed and
+    # available as FlowServer.port — what the bench and tests use).
+    host: str = "127.0.0.1"
+    port: int = 8000
+    # Shard each device call over N local devices (parallel.make_dp_eval_fn);
+    # batch steps are rounded up to multiples of N.  1 = single device.
+    dp_devices: int = 1
+    # AOT-compile every (bucket, batch-step) executable before accepting
+    # traffic.  Off skips straight to lazy compiles (first request per shape
+    # pays the compile — useful only for quick experiments).
+    warmup: bool = True
+
+    def __post_init__(self):
+        if self.batch_steps is None:
+            object.__setattr__(self, "batch_steps",
+                               default_batch_steps(self.max_batch))
+        if not self.buckets:
+            raise ValueError("at least one resolution bucket is required")
+        for h, w in self.buckets:
+            if h % 8 or w % 8:
+                raise ValueError(f"bucket ({h}, {w}): sides must be "
+                                 f"multiples of 8")
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {self.queue_depth}")
+        if self.dp_devices < 1:
+            raise ValueError(f"dp_devices must be >= 1, got {self.dp_devices}")
+        steps = tuple(sorted(set(self.batch_steps)))
+        if not steps or steps[0] < 1:
+            raise ValueError(f"batch_steps must be positive, got {steps}")
+        if self.dp_devices > 1:
+            # shard_map splits the batch across devices, so every executed
+            # size must divide: round each step UP to a multiple of N (the
+            # documented 'padded to multiples' behavior), dedup
+            n = self.dp_devices
+            steps = tuple(sorted({-(-s // n) * n for s in steps}))
+        if steps[-1] < self.max_batch:
+            raise ValueError(f"largest batch step {steps[-1]} < max_batch "
+                             f"{self.max_batch}: full batches could never run")
+        object.__setattr__(self, "batch_steps", steps)
+        object.__setattr__(self, "buckets", tuple(self.buckets))
+
+    def route(self, h: int, w: int):
+        """Smallest declared bucket containing (h, w), or None — minimal
+        padding wins; ties break toward fewer padded pixels."""
+        best = None
+        for bh, bw in self.buckets:
+            if h <= bh and w <= bw:
+                if best is None or bh * bw < best[0] * best[1]:
+                    best = (bh, bw)
+        return best
+
+    def pad_batch_to(self, n: int) -> int:
+        """Smallest compiled batch step >= n (n is capped at max_batch by
+        the batcher, and max_batch <= max(batch_steps) by construction)."""
+        for s in self.batch_steps:
+            if s >= n:
+                return s
+        return self.batch_steps[-1]

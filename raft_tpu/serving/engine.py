@@ -1,0 +1,126 @@
+"""Warm inference engine: one AOT-compiled executable per declared shape.
+
+The compile cache is keyed by (bucket H, bucket W, padded batch) over a
+fixed (config, params-dtype) pair.  ``warmup()`` lowers and compiles the
+whole (bucket x batch-step) grid up front — XLA's jit cache never decides
+anything at serve time, so a steady-state device call can only ever be a
+dictionary lookup plus execution (raftlint R2 discipline made structural).
+``compile_misses`` stays at its post-warmup value forever on a healthy
+server; the tests and the load bench assert exactly that.
+
+Sharded execution: ``dp_devices > 1`` wraps the same inference fn in
+``parallel.make_dp_eval_fn`` (shard_map over the 'data' axis), so a padded
+batch splits across local chips — batch steps are multiples of the device
+count by ServeConfig construction.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..config import RAFTConfig
+from .config import ServeConfig
+
+
+class InferenceEngine:
+    """(bucket, batch) -> compiled executable, with hit/miss accounting."""
+
+    def __init__(self, config: RAFTConfig, params, sconfig: ServeConfig,
+                 iters: Optional[int] = None):
+        import jax
+
+        self.config = config
+        self.sconfig = sconfig
+        self.iters = iters
+        self.params = jax.tree.map(jax.numpy.asarray, params)
+        self._mesh = None
+        if sconfig.dp_devices > 1:
+            from ..parallel import make_dp_eval_fn
+            from ..parallel.mesh import make_mesh
+            if len(jax.devices()) < sconfig.dp_devices:
+                raise ValueError(
+                    f"dp_devices={sconfig.dp_devices} but only "
+                    f"{len(jax.devices())} device(s) visible")
+            self._mesh = make_mesh(sconfig.dp_devices)
+            self._fn = make_dp_eval_fn(config, self._mesh, iters=iters)
+        else:
+            from ..models.raft import make_inference_fn
+            self._fn = jax.jit(make_inference_fn(config, iters=iters))
+        self._lock = threading.Lock()
+        self._exec: Dict[Tuple[int, int, int], object] = {}
+        self.compile_hits = 0
+        self.compile_misses = 0
+        self.warmup_seconds = 0.0
+
+    # -- compile-cache bookkeeping ---------------------------------------
+
+    def _compile(self, key: Tuple[int, int, int]):
+        import jax
+        import jax.numpy as jnp
+
+        h, w, b = key
+        spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
+        return self._fn.lower(self.params, spec, spec).compile()
+
+    def _get_executable(self, key: Tuple[int, int, int]):
+        with self._lock:
+            ex = self._exec.get(key)
+            if ex is not None:
+                self.compile_hits += 1
+                return ex
+            self.compile_misses += 1
+        # compile outside the lock would race duplicate compiles; the
+        # grid is tiny and warmup covers it, so hold the lock instead
+        with self._lock:
+            ex = self._exec.get(key)
+            if ex is None:
+                ex = self._compile(key)
+                self._exec[key] = ex
+            return ex
+
+    def warmup(self, verbose: bool = True) -> int:
+        """AOT-compile every declared (bucket, batch-step); returns the
+        number of executables built.  Warmup compiles are not counted as
+        cache misses — `compile_misses` measures serve-time surprises."""
+        t0 = time.monotonic()
+        n = 0
+        for (h, w) in self.sconfig.buckets:
+            for b in self.sconfig.batch_steps:
+                key = (h, w, b)
+                with self._lock:
+                    if key in self._exec:
+                        continue
+                ex = self._compile(key)
+                with self._lock:
+                    self._exec.setdefault(key, ex)
+                n += 1
+                if verbose:
+                    print(f"[serve] warmed bucket {h}x{w} batch {b} "
+                          f"({time.monotonic() - t0:.1f}s elapsed)")
+        self.warmup_seconds = time.monotonic() - t0
+        return n
+
+    @property
+    def executables(self) -> int:
+        with self._lock:
+            return len(self._exec)
+
+    def keys(self):
+        with self._lock:
+            return sorted(self._exec)
+
+    # -- the device call --------------------------------------------------
+
+    def run(self, bucket: Tuple[int, int], im1: np.ndarray,
+            im2: np.ndarray) -> np.ndarray:
+        """[n, BH, BW, 3] float32 pair -> [n, BH, BW, 2] float32 flow.
+        ``n`` must be a declared batch step (the batcher pads to one)."""
+        h, w = bucket
+        n = im1.shape[0]
+        ex = self._get_executable((h, w, n))
+        flow = ex(self.params, im1, im2)
+        return np.asarray(flow)

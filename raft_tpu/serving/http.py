@@ -1,0 +1,213 @@
+"""HTTP surface of the serving stack — stdlib ``http.server`` only.
+
+Endpoints:
+
+  POST /v1/flow   infer optical flow for one image pair
+  GET  /healthz   liveness/readiness (503 while draining)
+  GET  /metrics   Prometheus text exposition
+
+``/v1/flow`` accepts two encodings:
+
+* ``application/json``: ``{"image1": [[[...]]], "image2": [[[...]]],
+  "deadline_ms": 500}`` — images as [H][W][3] nested lists of floats in
+  [0, 1] (uint8 values 0-255 also accepted and rescaled).  Response JSON
+  carries ``flow`` ([H][W][2]) plus routing/latency metadata.
+* ``application/octet-stream``: an ``.npz`` body with ``image1``/``image2``
+  arrays ([H, W, 3], float32 in [0, 1] or uint8) and optional scalar
+  ``deadline_ms``.  With ``Accept: application/octet-stream`` the response
+  is an ``.npz`` holding ``flow`` — the cheap path for real clients and
+  the load bench.
+
+Error statuses: 400 malformed/unroutable input, 404 unknown path, 413 body
+too large, 429 queue full (shed — retry with backoff), 503 draining,
+504 deadline exceeded.  Every terminal status increments
+``raft_serving_requests_total{status=...}``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .queue import RejectedError
+
+MAX_BODY_BYTES = 256 * 2**20   # one 4K pair is ~100 MB as float32 JSON
+
+
+class BadRequest(Exception):
+    pass
+
+
+def _decode_image(obj, name: str) -> np.ndarray:
+    arr = np.asarray(obj, dtype=np.float32)
+    if arr.ndim != 3 or arr.shape[-1] != 3:
+        raise BadRequest(f"{name} must have shape [H, W, 3], "
+                         f"got {list(arr.shape)}")
+    if arr.shape[0] < 1 or arr.shape[1] < 1:
+        raise BadRequest(f"{name} is empty: shape {list(arr.shape)}")
+    if not np.isfinite(arr).all():
+        raise BadRequest(f"{name} contains non-finite values")
+    if arr.max() > 1.5:                    # uint8-range payload
+        arr = arr / 255.0
+    return arr
+
+
+def parse_flow_request(body: bytes, content_type: str):
+    """-> (image1, image2, deadline_ms or None).  Raises BadRequest."""
+    ct = (content_type or "").split(";")[0].strip().lower()
+    if ct == "application/octet-stream":
+        try:
+            with np.load(io.BytesIO(body)) as z:
+                if "image1" not in z or "image2" not in z:
+                    raise BadRequest("npz body must contain image1 and image2")
+                im1 = _decode_image(z["image1"], "image1")
+                im2 = _decode_image(z["image2"], "image2")
+                dl = float(z["deadline_ms"]) if "deadline_ms" in z else None
+        except BadRequest:
+            raise
+        except Exception as e:
+            raise BadRequest(f"could not read npz body: {e}")
+        return im1, im2, dl
+    # default: JSON
+    try:
+        payload = json.loads(body)
+    except Exception as e:
+        raise BadRequest(f"invalid JSON body: {e}")
+    if not isinstance(payload, dict):
+        raise BadRequest("JSON body must be an object")
+    for k in ("image1", "image2"):
+        if k not in payload:
+            raise BadRequest(f"missing field {k!r}")
+    try:
+        im1 = _decode_image(payload["image1"], "image1")
+        im2 = _decode_image(payload["image2"], "image2")
+    except BadRequest:
+        raise
+    except Exception as e:
+        raise BadRequest(f"could not decode images: {e}")
+    dl = payload.get("deadline_ms")
+    if dl is not None:
+        try:
+            dl = float(dl)
+        except (TypeError, ValueError):
+            raise BadRequest("deadline_ms must be a number")
+    return im1, im2, dl
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the FlowServer instance; set on the subclass by make_http_server
+    server_app = None
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ---------------------------------------------------------
+
+    def log_message(self, fmt, *args):   # route through the app, not stderr
+        app = self.server_app
+        if app is not None and app.verbose:
+            print(f"[serve] {self.address_string()} {fmt % args}")
+
+    def _send(self, status: int, body: bytes, content_type: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, status: int, obj) -> None:
+        self._send(status, json.dumps(obj).encode(),
+                   "application/json")
+
+    # -- endpoints --------------------------------------------------------
+
+    def do_GET(self):
+        app = self.server_app
+        path = self.path.split("?")[0]
+        if path == "/healthz":
+            if app.draining:
+                self._send_json(503, {"status": "draining"})
+            else:
+                self._send_json(200, {
+                    "status": "ok",
+                    "buckets": [list(b) for b in app.sconfig.buckets],
+                    "batch_steps": list(app.sconfig.batch_steps),
+                    "queue_depth": len(app.queue),
+                    "executables": app.engine_executables(),
+                })
+        elif path == "/metrics":
+            self._send(200, app.registry.render().encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        else:
+            self._send_json(404, {"error": f"no handler for {path}"})
+
+    def do_POST(self):
+        app = self.server_app
+        path = self.path.split("?")[0]
+        if path != "/v1/flow":
+            self._send_json(404, {"error": f"no handler for {path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = -1
+        if length < 0 or length > MAX_BODY_BYTES:
+            app.count_request("bad_request")
+            self._send_json(413, {"error": "bad or oversized Content-Length"})
+            return
+        body = self.rfile.read(length)
+        try:
+            im1, im2, deadline_ms = parse_flow_request(
+                body, self.headers.get("Content-Type", "application/json"))
+            if im1.shape != im2.shape:
+                raise BadRequest(f"image shapes differ: {list(im1.shape)} "
+                                 f"vs {list(im2.shape)}")
+        except BadRequest as e:
+            app.count_request("bad_request")
+            self._send_json(400, {"error": str(e)})
+            return
+        try:
+            req = app.infer(im1, im2, deadline_ms)
+        except RejectedError as e:
+            # rejected/timeout accounting happens where the decision is
+            # made (submit / batcher purge / wait timeout); just translate
+            # to HTTP here
+            self._send_json(e.http_status, {"error": str(e)})
+            return
+        except BadRequest as e:
+            app.count_request("bad_request")
+            self._send_json(400, {"error": str(e)})
+            return
+        except Exception as e:
+            # engine/batcher failure (already counted status="error" where
+            # the batch died): a proper 500, not a dropped socket
+            self._send_json(500, {"error": f"inference failed: {e}"})
+            return
+        meta = {
+            "bucket": list(req.bucket),
+            "batch_real": req.batch_real,
+            "batch_padded": req.batch_padded,
+        }
+        if "application/octet-stream" in (self.headers.get("Accept") or ""):
+            buf = io.BytesIO()
+            np.savez(buf, flow=req.result,
+                     bucket=np.asarray(req.bucket, np.int32))
+            self._send(200, buf.getvalue(), "application/octet-stream")
+        else:
+            self._send_json(200, {"flow": req.result.tolist(), "meta": meta})
+
+
+def make_http_server(app, host: str, port: int) -> ThreadingHTTPServer:
+    handler = type("BoundHandler", (_Handler,), {"server_app": app})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.daemon_threads = True
+    return httpd
+
+
+def serve_in_thread(httpd: ThreadingHTTPServer) -> threading.Thread:
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="raft-serving-http")
+    t.start()
+    return t

@@ -1,0 +1,222 @@
+"""FlowServer: queue + micro-batcher + warm engine + HTTP, composed.
+
+Lifecycle::
+
+    server = FlowServer(config, params, sconfig)
+    server.start()            # warms the compile grid, binds the port
+    ...                       # serve_forever happens on daemon threads
+    server.stop(drain=True)   # 503 new work, finish what's queued, exit
+
+``stop(drain=True)`` is the graceful path: the admission queue closes
+(submissions -> 503), the batcher flushes every queued request — max_wait
+is ignored once draining — and in-flight device batches run to completion
+before their handler threads are released; only then does the HTTP listener
+shut down.  ``drain=False`` fails queued requests immediately instead.
+
+serve_cli is the ``python -m raft_tpu.cli -m serve`` entry point.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..config import RAFTConfig
+from ..data.pipeline import pad_to_shape
+from .batcher import MicroBatcher
+from .config import ServeConfig
+from .engine import InferenceEngine
+from .http import BadRequest, make_http_server, serve_in_thread
+from .metrics import Registry, make_serving_metrics
+from .queue import DeadlineExceeded, Draining, Request, RequestQueue
+
+
+class FlowServer:
+    def __init__(self, config: RAFTConfig, params, sconfig: ServeConfig,
+                 iters: Optional[int] = None, engine=None,
+                 verbose: bool = False):
+        self.sconfig = sconfig
+        self.verbose = verbose
+        self.registry = Registry()
+        self.queue = RequestQueue(sconfig.queue_depth)
+        self.metrics = make_serving_metrics(
+            self.registry, sconfig, queue_depth_fn=lambda: len(self.queue))
+        self.registry.gauge("raft_serving_queue_limit",
+                            "Admission queue capacity (backpressure bound)"
+                            ).set(sconfig.queue_depth)
+        # engine injection: tests drive the batching policy with stubs
+        self.engine = engine if engine is not None else InferenceEngine(
+            config, params, sconfig, iters=iters)
+        self.batcher = MicroBatcher(
+            self.queue, self._run_engine, sconfig.pad_batch_to,
+            sconfig.max_batch, sconfig.max_wait_ms, metrics=self.metrics)
+        self._httpd = None
+        self._http_thread = None
+        self._draining = threading.Event()
+        self._stopped = threading.Event()
+        self._gauges_wired = False
+
+    # -- engine bridge (compile-cache accounting lives server-side so a
+    #    stub engine still produces hit/miss metrics when it exposes them) -
+
+    def _run_engine(self, bucket, im1, im2):
+        before = getattr(self.engine, "compile_misses", None)
+        out = self.engine.run(bucket, im1, im2)
+        if before is not None:
+            after = self.engine.compile_misses
+            if after > before:
+                self.metrics["compile_misses"].inc(after - before)
+            else:
+                self.metrics["compile_hits"].inc()
+        return out
+
+    def engine_executables(self) -> int:
+        return getattr(self.engine, "executables", 0)
+
+    def count_request(self, status: str) -> None:
+        self.metrics["requests"].labels(status).inc()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        if not self._gauges_wired:
+            self._gauges_wired = True
+            self.registry.gauge("raft_serving_compile_cache_entries",
+                                "Warm executables resident",
+                                fn=self.engine_executables)
+        if self.sconfig.warmup and hasattr(self.engine, "warmup"):
+            n = self.engine.warmup(verbose=self.verbose)
+            if self.verbose:
+                print(f"[serve] warmup compiled {n} executable(s) in "
+                      f"{self.engine.warmup_seconds:.1f}s")
+        self.batcher.start()
+        self._httpd = make_http_server(self, self.sconfig.host,
+                                       self.sconfig.port)
+        self._http_thread = serve_in_thread(self._httpd)
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else 0
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.sconfig.host}:{self.port}"
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Idempotent graceful (or immediate) shutdown."""
+        if self._stopped.is_set():
+            return
+        self._draining.set()
+        if not drain:
+            for r in self.queue.drain_remaining():
+                self.count_request("draining")
+                r.fail(Draining("server shut down before this request ran"))
+        self.queue.close()            # batcher drains the rest, then exits
+        self.batcher.join(timeout)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self._stopped.set()
+
+    def wait(self) -> None:
+        """Block until stop() completes (the CLI foreground call)."""
+        while not self._stopped.is_set():
+            self._stopped.wait(0.5)
+
+    # -- request path ------------------------------------------------------
+
+    def infer(self, im1: np.ndarray, im2: np.ndarray,
+              deadline_ms: Optional[float] = None) -> Request:
+        """Route, pad, enqueue, block until resolved.  Called from HTTP
+        handler threads (and directly by tests/the in-process bench)."""
+        if self.draining:
+            self.count_request("draining")
+            raise Draining("server is draining; not accepting requests")
+        h, w = im1.shape[0], im1.shape[1]
+        bucket = self.sconfig.route(h, w)
+        if bucket is None:
+            raise BadRequest(
+                f"no declared bucket fits ({h}, {w}); buckets: "
+                f"{[f'{bh}x{bw}' for bh, bw in self.sconfig.buckets]}")
+        dl = self.sconfig.default_deadline_ms if deadline_ms is None \
+            else min(deadline_ms, self.sconfig.default_deadline_ms)
+        if dl <= 0:
+            raise BadRequest(f"deadline_ms must be positive, got {dl}")
+        im1p, pads = pad_to_shape(im1[None].astype(np.float32), bucket)
+        im2p, _ = pad_to_shape(im2[None].astype(np.float32), bucket)
+        req = Request(im1p, im2p, bucket, pads,
+                      deadline=time.monotonic() + dl / 1000.0)
+        try:
+            self.queue.submit(req)
+        except Draining:
+            self.count_request("draining")
+            raise
+        except Exception:           # QueueFull: overload shed, HTTP 429
+            self.count_request("shed")
+            raise
+        # the generous margin past the deadline covers an in-flight batch
+        # that dequeued the request just before its deadline: it completes
+        try:
+            req.wait(timeout=dl / 1000.0 + max(30.0, dl / 1000.0))
+        except DeadlineExceeded:
+            if req.error is None:
+                # wait() itself timed out (batch overran / batcher stalled)
+                # — the batcher's purge accounting never saw this one
+                self.count_request("timeout")
+            raise
+        return req
+
+
+def serve_cli(args, config: RAFTConfig, load_params) -> int:
+    """-m serve: build, warm, serve until SIGINT/SIGTERM, drain, exit 0."""
+    import signal
+
+    from .config import parse_buckets
+
+    try:
+        sconfig = ServeConfig(
+            buckets=parse_buckets(args.buckets),
+            max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            queue_depth=args.queue_depth,
+            default_deadline_ms=args.deadline_ms,
+            host=args.host, port=args.port,
+            dp_devices=args.serve_dp or 1,
+            warmup=not args.no_warmup)
+    except ValueError as e:
+        print(f"ERROR: {e}")
+        return 2
+    params = load_params(args, config)
+    server = FlowServer(config, params, sconfig, iters=args.iters,
+                        verbose=True)
+    t0 = time.monotonic()
+    server.start()
+    print(f"[serve] listening on {server.url}  "
+          f"buckets={[f'{h}x{w}' for h, w in sconfig.buckets]}  "
+          f"max_batch={sconfig.max_batch}  "
+          f"batch_steps={list(sconfig.batch_steps)}  "
+          f"max_wait={sconfig.max_wait_ms}ms  "
+          f"queue_depth={sconfig.queue_depth}  "
+          f"({time.monotonic() - t0:.1f}s to ready)")
+    print(f"[serve] POST {server.url}/v1/flow   "
+          f"GET {server.url}/healthz   GET {server.url}/metrics")
+
+    def _stop(signum, frame):
+        print(f"\n[serve] signal {signum}: draining "
+              f"({len(server.queue)} queued)...")
+        threading.Thread(target=server.stop, daemon=True).start()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    server.wait()
+    b = server.batcher
+    print(f"[serve] drained and stopped  served={b.served} "
+          f"batches={b.batches} timed_out={b.timed_out}")
+    return 0

@@ -222,22 +222,30 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
         prev_lr = None
         with ThreadPoolExecutor(max_workers=1) as pool:
             fut = pool.submit(_load, 0) if n else None
-            for idx in range(n):
-                im1p, im2p, pads, flow_gt, valid = fut.result()
-                if idx + 1 < n:
-                    fut = pool.submit(_load, idx + 1)
-                shapes_seen.add((1,) + im1p.shape[1:])
-                h8, w8 = im1p.shape[1] // 8, im1p.shape[2] // 8
-                if (dataset.is_scene_start(idx) or prev_lr is None
-                        or prev_lr.shape[1:3] != (h8, w8)):
-                    init = np.zeros((1, h8, w8, 2), np.float32)
-                else:
-                    init = forward_interpolate(prev_lr[0])[None]
-                flow_dev, lr_dev = warm_fn(params, jnp.asarray(im1p),
-                                           jnp.asarray(im2p),
-                                           jnp.asarray(init))
-                prev_lr = np.asarray(lr_dev)
-                account(flow_dev, [(im1p, im2p, pads, flow_gt, valid, idx)])
+            try:
+                for idx in range(n):
+                    im1p, im2p, pads, flow_gt, valid = fut.result()
+                    if idx + 1 < n:
+                        fut = pool.submit(_load, idx + 1)
+                    shapes_seen.add((1,) + im1p.shape[1:])
+                    h8, w8 = im1p.shape[1] // 8, im1p.shape[2] // 8
+                    if (dataset.is_scene_start(idx) or prev_lr is None
+                            or prev_lr.shape[1:3] != (h8, w8)):
+                        init = np.zeros((1, h8, w8, 2), np.float32)
+                    else:
+                        init = forward_interpolate(prev_lr[0])[None]
+                    flow_dev, lr_dev = warm_fn(params, jnp.asarray(im1p),
+                                               jnp.asarray(im2p),
+                                               jnp.asarray(init))
+                    prev_lr = np.asarray(lr_dev)
+                    account(flow_dev,
+                            [(im1p, im2p, pads, flow_gt, valid, idx)])
+            finally:
+                # if warm_fn/account raised mid-loop, don't let the pending
+                # lookahead _load run to completion (and have its own
+                # exception swallowed) during executor shutdown (ADVICE r5)
+                if fut is not None:
+                    fut.cancel()
     else:
         groups: Dict[tuple, list] = {}
         for idx in range(n):

@@ -226,3 +226,36 @@ def test_dtype_default_resolution(monkeypatch):
         make_args("train", "bfloat16")).compute_dtype == "bfloat16"
     assert cli._make_config(
         make_args("test", "float32")).compute_dtype == "float32"
+    # serve is an inference mode: bf16 on TPU unless overridden
+    assert cli._make_config(make_args("serve")).compute_dtype == "bfloat16"
+
+
+def test_val_submission_export_pins_float32(monkeypatch, capsys):
+    """On TPU, val mode defaults to bf16 — EXCEPT when producing a
+    testing-split submission export (--split testing --dump-flow), whose
+    artifacts must not vary with the host backend (ADVICE r5); an explicit
+    --dtype still wins."""
+    import argparse
+
+    import jax
+
+    def make_args(split=None, dump_flow=None, dtype=None):
+        return argparse.Namespace(
+            mode="val", dtype=dtype, corr_impl="dense", ctx_hoist=None,
+            corr_lookup=None, iters=None, small=True, split=split,
+            dump_flow=dump_flow)
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    cfg = cli._make_config(make_args(split="testing", dump_flow="out/sub"))
+    assert cfg.compute_dtype == "float32"
+    assert "pinning float32" in capsys.readouterr().out
+    # metrics-only runs (no dump, or training split) keep the bf16 default
+    assert cli._make_config(make_args()).compute_dtype == "bfloat16"
+    assert cli._make_config(
+        make_args(split="training", dump_flow="d")).compute_dtype == "bfloat16"
+    assert cli._make_config(
+        make_args(split="testing")).compute_dtype == "bfloat16"
+    # explicit opt-in beats the pin
+    assert cli._make_config(
+        make_args(split="testing", dump_flow="d",
+                  dtype="bfloat16")).compute_dtype == "bfloat16"

@@ -1,0 +1,503 @@
+"""Serving-stack tests (tier-1, CPU): batching policy on a stub engine
+(deterministic — the engine blocks on events, no timing races), the live
+warm-engine + HTTP surface on a tiny model, and the backpressure/deadline/
+drain contracts the ISSUE acceptance criteria name.
+
+The stub-engine tests never compile anything; the live-server fixture is
+module-scoped so its warmup grid (2 buckets x 1 batch step) compiles once.
+"""
+
+import io
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from raft_tpu.serving import (DeadlineExceeded, Draining, FlowServer,
+                              MicroBatcher, QueueFull, Registry, Request,
+                              RequestQueue, ServeConfig, default_batch_steps,
+                              parse_buckets)
+from raft_tpu.serving.metrics import Counter, Gauge, Histogram
+
+
+# ---------------------------------------------------------------- config --
+
+def test_parse_buckets():
+    assert parse_buckets("432x1024") == ((432, 1024),)
+    assert parse_buckets("32x48, 64x96") == ((32, 48), (64, 96))
+    with pytest.raises(ValueError):
+        parse_buckets("33x48")          # not /8
+    with pytest.raises(ValueError):
+        parse_buckets("nonsense")
+    with pytest.raises(ValueError):
+        parse_buckets("")
+
+
+def test_default_batch_steps():
+    assert default_batch_steps(1) == (1,)
+    assert default_batch_steps(4) == (1, 2, 4)
+    assert default_batch_steps(6) == (1, 2, 4, 6)
+
+
+def test_route_smallest_fitting_bucket():
+    sc = ServeConfig(buckets=((64, 96), (32, 48), (128, 128)), max_batch=2)
+    assert sc.route(30, 44) == (32, 48)       # smallest fit wins
+    assert sc.route(32, 48) == (32, 48)       # exact fit
+    assert sc.route(33, 48) == (64, 96)
+    assert sc.route(100, 100) == (128, 128)
+    assert sc.route(200, 48) is None          # taller than every bucket
+
+
+def test_serve_config_validation():
+    with pytest.raises(ValueError):
+        ServeConfig(buckets=((30, 48),))           # not /8
+    with pytest.raises(ValueError):
+        ServeConfig(buckets=())
+    with pytest.raises(ValueError):
+        ServeConfig(max_batch=4, batch_steps=(1, 2))   # can't fit a full batch
+    sc = ServeConfig(max_batch=4, dp_devices=2, batch_steps=(1, 2, 4))
+    assert sc.batch_steps == (2, 4)           # rounded up to multiples, dedup
+    sc = ServeConfig(max_batch=4, dp_devices=3, batch_steps=(1, 2, 4))
+    assert sc.batch_steps == (3, 6)           # every step divisible by N
+    assert ServeConfig(max_batch=3).pad_batch_to(2) == 2
+    assert ServeConfig(max_batch=3).pad_batch_to(3) == 3
+
+
+# --------------------------------------------------------------- metrics --
+
+def test_metrics_exposition_format():
+    reg = Registry()
+    c = reg.counter("t_requests_total", "requests", labelnames=("status",))
+    c.labels("ok").inc()
+    c.labels("ok").inc(2)
+    c.labels("shed").inc()
+    g = reg.gauge("t_depth", "depth")
+    g.set(7)
+    h = reg.histogram("t_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.render()
+    assert '# TYPE t_requests_total counter' in text
+    assert 't_requests_total{status="ok"} 3' in text
+    assert 't_requests_total{status="shed"} 1' in text
+    assert 't_depth 7' in text
+    assert 't_lat_seconds_bucket{le="0.1"} 1' in text
+    assert 't_lat_seconds_bucket{le="1"} 2' in text
+    assert 't_lat_seconds_bucket{le="+Inf"} 3' in text
+    assert 't_lat_seconds_count 3' in text
+    assert abs(h.mean() - (0.05 + 0.5 + 5.0) / 3) < 1e-9
+    with pytest.raises(ValueError):
+        reg.counter("t_depth", "dup name")
+    with pytest.raises(ValueError):
+        Counter("c", "x").inc(-1)
+    cb = Gauge("g", "callback", fn=lambda: 42)
+    assert cb.value == 42
+
+
+# ------------------------------------------------- batching policy (stub) --
+
+BUCKET = (32, 48)
+
+
+def make_request(deadline_s=30.0, bucket=BUCKET):
+    h, w = bucket
+    im = np.zeros((1, h, w, 3), np.float32)
+    return Request(im, im, bucket, (0, 0, 0, 0),
+                   deadline=time.monotonic() + deadline_s)
+
+
+class StubEngine:
+    """Counts calls; optionally blocks each call on a gate event."""
+
+    def __init__(self, gate=None, fail=False):
+        self.calls = []               # (bucket, batch_size)
+        self.gate = gate
+        self.fail = fail
+        self.entered = threading.Event()
+
+    def run(self, bucket, im1, im2):
+        self.entered.set()
+        if self.gate is not None:
+            assert self.gate.wait(30)
+        self.calls.append((bucket, im1.shape[0]))
+        if self.fail:
+            raise RuntimeError("engine exploded")
+        return np.zeros(im1.shape[:3] + (2,), np.float32)
+
+
+def make_stub_stack(engine, max_batch=4, max_wait_ms=30.0, depth=16,
+                    batch_steps=None):
+    q = RequestQueue(depth)
+    steps = batch_steps or default_batch_steps(max_batch)
+    pad = lambda n: next(s for s in steps if s >= n)
+    b = MicroBatcher(q, engine.run, pad, max_batch, max_wait_ms)
+    b.start()
+    return q, b
+
+
+def test_batcher_coalesces_full_batch():
+    """4 requests arriving within max_wait -> ONE device call of 4 (the
+    full-batch pop fires on the 4th submission, not on aging)."""
+    eng = StubEngine()
+    q, b = make_stub_stack(eng, max_batch=4, max_wait_ms=10_000.0)
+    reqs = [make_request() for _ in range(4)]
+    t0 = time.monotonic()
+    for r in reqs:
+        q.submit(r)
+    flows = [r.wait(timeout=10) for r in reqs]
+    assert eng.calls == [(BUCKET, 4)]           # coalesced, one call
+    assert time.monotonic() - t0 < 5            # did NOT age out max_wait
+    assert all(f.shape == (32, 48, 2) for f in flows)
+    assert all(r.batch_real == 4 and r.batch_padded == 4 for r in reqs)
+    q.close()
+    b.join(5)
+
+
+def test_max_wait_partial_flush_pads_to_step():
+    """A lone request flushes after max_wait, padded up to the next declared
+    batch step (occupancy 1/2)."""
+    eng = StubEngine()
+    q, b = make_stub_stack(eng, max_batch=4, max_wait_ms=20.0,
+                           batch_steps=(2, 4))
+    r = make_request()
+    t0 = time.monotonic()
+    q.submit(r)
+    r.wait(timeout=10)
+    assert time.monotonic() - t0 >= 0.015       # really waited for mates
+    assert eng.calls == [(BUCKET, 2)]           # padded 1 -> step 2
+    assert (r.batch_real, r.batch_padded) == (1, 2)
+    q.close()
+    b.join(5)
+
+
+def test_bucket_fifo_no_cross_bucket_mixing():
+    """Same-bucket requests coalesce; a different bucket rides a separate
+    batch — shapes never mix inside one device call."""
+    gate = threading.Event()
+    eng = StubEngine(gate=gate)
+    q, b = make_stub_stack(eng, max_batch=4, max_wait_ms=15.0)
+    warm = make_request()
+    q.submit(warm)
+    assert eng.entered.wait(10)
+    small = [make_request() for _ in range(2)]
+    big = [make_request(bucket=(64, 96)) for _ in range(2)]
+    for r in (small[0], big[0], small[1], big[1]):   # interleaved arrival
+        q.submit(r)
+    gate.set()
+    for r in small + big + [warm]:
+        r.wait(timeout=10)
+    assert sorted(eng.calls[1:]) == [((32, 48), 2), ((64, 96), 2)]
+    q.close()
+    b.join(5)
+
+
+def test_deadline_timeout_while_queued():
+    """A request whose deadline passes in the queue gets DeadlineExceeded
+    and never reaches the device."""
+    gate = threading.Event()
+    eng = StubEngine(gate=gate)
+    q, b = make_stub_stack(eng, max_batch=2, max_wait_ms=5.0)
+    first = make_request()
+    q.submit(first)                    # engine blocks on the gate
+    assert eng.entered.wait(10)
+    doomed = make_request(deadline_s=0.05)
+    q.submit(doomed)
+    time.sleep(0.15)                   # deadline passes while queued
+    gate.set()
+    first.wait(timeout=10)
+    with pytest.raises(DeadlineExceeded):
+        doomed.wait(timeout=10)
+    assert all(n == 1 for _, n in eng.calls)    # doomed never executed
+    assert b.timed_out == 1
+    q.close()
+    b.join(5)
+
+
+def test_overload_sheds_with_queue_full():
+    """Submissions past queue_depth raise QueueFull immediately — bounded
+    memory, 429 at the HTTP layer — and queued work still completes."""
+    gate = threading.Event()
+    eng = StubEngine(gate=gate)
+    q, b = make_stub_stack(eng, max_batch=1, max_wait_ms=5.0, depth=2)
+    inflight = make_request()
+    q.submit(inflight)
+    assert eng.entered.wait(10)        # engine busy; queue now empty
+    queued = [make_request() for _ in range(2)]
+    for r in queued:
+        q.submit(r)                    # fills the depth-2 queue
+    with pytest.raises(QueueFull):
+        q.submit(make_request())
+    gate.set()
+    inflight.wait(timeout=10)
+    for r in queued:
+        r.wait(timeout=10)
+    q.close()
+    b.join(5)
+
+
+def test_graceful_drain_completes_queued_work():
+    """close() lets the batcher flush everything already admitted — without
+    waiting out max_wait — then exit; later submissions are refused."""
+    eng = StubEngine()
+    q, b = make_stub_stack(eng, max_batch=4, max_wait_ms=10_000.0)
+    reqs = [make_request() for _ in range(3)]
+    for r in reqs:
+        q.submit(r)                    # 3 < max_batch: would age 10s
+    q.close()                          # drain: flush immediately instead
+    with pytest.raises(Draining):
+        q.submit(make_request())
+    t0 = time.monotonic()
+    for r in reqs:
+        assert r.wait(timeout=10).shape == (32, 48, 2)
+    assert time.monotonic() - t0 < 5   # drained, did not age out max_wait
+    assert eng.calls == [(BUCKET, 4)]  # one partial batch, padded 3 -> 4
+    assert all(r.batch_real == 3 and r.batch_padded == 4 for r in reqs)
+    b.join(10)
+    assert not b.alive                 # batcher exited after the drain
+    assert b.served == 3
+
+
+def test_engine_failure_fails_the_batch_not_the_server():
+    eng = StubEngine(fail=True)
+    q, b = make_stub_stack(eng, max_batch=2, max_wait_ms=5.0)
+    r = make_request()
+    q.submit(r)
+    with pytest.raises(RuntimeError, match="engine exploded"):
+        r.wait(timeout=10)
+    # batcher survives and serves the next request
+    eng.fail = False
+    r2 = make_request()
+    q.submit(r2)
+    assert r2.wait(timeout=10).shape == (32, 48, 2)
+    q.close()
+    b.join(5)
+
+
+# ------------------------------------------- live server (warm engine) ----
+
+@pytest.fixture(scope="module")
+def live_server():
+    from raft_tpu.config import RAFTConfig, init_rng
+    from raft_tpu.models import init_raft
+
+    config = RAFTConfig.small_model(iters=1)
+    params = init_raft(init_rng(), config)
+    # max_wait 150ms: wide enough that two concurrent posts always coalesce,
+    # short enough that lone-request tests stay fast
+    sconfig = ServeConfig(buckets=((32, 48), (64, 96)), max_batch=2,
+                          batch_steps=(2,), max_wait_ms=150.0,
+                          queue_depth=16, default_deadline_ms=30_000.0,
+                          port=0)
+    server = FlowServer(config, params, sconfig)
+    server.start()
+    yield server, config, params
+    server.stop()
+
+
+def _post_json(server, im1, im2, deadline_ms=None):
+    payload = {"image1": im1.tolist(), "image2": im2.tolist()}
+    if deadline_ms is not None:
+        payload["deadline_ms"] = deadline_ms
+    req = urllib.request.Request(
+        server.url + "/v1/flow", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_live_warmup_compiled_one_executable_per_bucket(live_server):
+    server, _, _ = live_server
+    eng = server.engine
+    # 2 buckets x 1 batch step: exactly one warm executable per bucket
+    assert eng.executables == 2
+    assert eng.keys() == [(32, 48, 2), (64, 96, 2)]
+    assert eng.compile_misses == 0
+
+
+def test_live_http_flow_matches_direct_inference(live_server):
+    """The full HTTP -> queue -> batcher -> warm engine -> unpad path must
+    agree with a direct jitted call on the same padded input."""
+    import jax
+    from raft_tpu.data.pipeline import pad_to_shape, unpad
+    from raft_tpu.models.raft import make_inference_fn
+
+    server, config, params = live_server
+    rng = np.random.RandomState(3)
+    im1 = rng.rand(30, 44, 3).astype(np.float32)       # pads to 32x48
+    im2 = rng.rand(30, 44, 3).astype(np.float32)
+    resp = _post_json(server, im1, im2)
+    flow = np.asarray(resp["flow"], np.float32)
+    assert flow.shape == (30, 44, 2)
+    assert resp["meta"]["bucket"] == [32, 48]
+
+    fn = jax.jit(make_inference_fn(config, iters=1))
+    im1p, pads = pad_to_shape(im1[None], (32, 48))
+    im2p, _ = pad_to_shape(im2[None], (32, 48))
+    want = unpad(np.asarray(fn(params, im1p, im2p)), pads)[0]
+    np.testing.assert_allclose(flow, want, atol=1e-4, rtol=1e-4)
+
+
+def test_live_concurrent_requests_coalesce_and_reuse_cache(live_server):
+    """Two concurrent posts ride ONE device batch (occupancy 2/2), routed
+    to the small bucket, with zero compile misses — the no-recompile-storm
+    guarantee, asserted via the engine's own trace counters."""
+    server, _, _ = live_server
+    eng = server.engine
+    misses_before = eng.compile_misses
+    hits_before = eng.compile_hits
+    rng = np.random.RandomState(4)
+    ims = [rng.rand(32, 48, 3).astype(np.float32) for _ in range(4)]
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        futs = [pool.submit(_post_json, server, ims[2 * i], ims[2 * i + 1])
+                for i in range(2)]
+        resps = [f.result() for f in futs]
+    assert all(r["meta"]["bucket"] == [32, 48] for r in resps)
+    # batch occupancy > 1: both requests shared one padded-2 device call
+    assert all(r["meta"]["batch_padded"] == 2 for r in resps)
+    assert any(r["meta"]["batch_real"] == 2 for r in resps)
+    assert eng.compile_misses == misses_before       # nothing recompiled
+    assert eng.compile_hits > hits_before
+
+
+def test_live_bucket_routing_second_bucket(live_server):
+    server, _, _ = live_server
+    rng = np.random.RandomState(5)
+    im = rng.rand(50, 60, 3).astype(np.float32)       # only 64x96 fits
+    resp = _post_json(server, im, im)
+    assert resp["meta"]["bucket"] == [64, 96]
+    assert np.asarray(resp["flow"]).shape == (50, 60, 2)
+    assert server.engine.compile_misses == 0
+
+
+def test_live_npz_round_trip(live_server):
+    server, _, _ = live_server
+    rng = np.random.RandomState(6)
+    im = rng.rand(32, 48, 3).astype(np.float32)
+    buf = io.BytesIO()
+    np.savez(buf, image1=im, image2=im)
+    req = urllib.request.Request(
+        server.url + "/v1/flow", data=buf.getvalue(),
+        headers={"Content-Type": "application/octet-stream",
+                 "Accept": "application/octet-stream"})
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 200
+        with np.load(io.BytesIO(r.read())) as z:
+            assert z["flow"].shape == (32, 48, 2)
+            assert np.isfinite(z["flow"]).all()
+
+
+def test_live_http_error_statuses(live_server):
+    server, _, _ = live_server
+
+    def post_raw(body, ct="application/json"):
+        req = urllib.request.Request(server.url + "/v1/flow", data=body,
+                                     headers={"Content-Type": ct})
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    st, body = post_raw(b"not json")
+    assert st == 400 and "JSON" in body["error"]
+    st, body = post_raw(json.dumps({"image1": [[[0.0] * 3]]}).encode())
+    assert st == 400 and "image2" in body["error"]
+    # shape mismatch between the pair
+    im_a = np.zeros((8, 8, 3)).tolist()
+    im_b = np.zeros((8, 16, 3)).tolist()
+    st, body = post_raw(json.dumps(
+        {"image1": im_a, "image2": im_b}).encode())
+    assert st == 400 and "differ" in body["error"]
+    # larger than every declared bucket -> unroutable
+    big = np.zeros((72, 104, 3)).tolist()
+    st, body = post_raw(json.dumps(
+        {"image1": big, "image2": big}).encode())
+    assert st == 400 and "bucket" in body["error"]
+    # unknown path
+    try:
+        with urllib.request.urlopen(server.url + "/nope") as r:
+            st = r.status
+    except urllib.error.HTTPError as e:
+        st = e.code
+    assert st == 404
+
+
+def test_live_healthz_and_metrics(live_server):
+    server, _, _ = live_server
+    with urllib.request.urlopen(server.url + "/healthz") as r:
+        assert r.status == 200
+        h = json.loads(r.read())
+    assert h["status"] == "ok"
+    assert h["buckets"] == [[32, 48], [64, 96]]
+    assert h["executables"] == 2
+    with urllib.request.urlopen(server.url + "/metrics") as r:
+        assert r.status == 200
+        assert "text/plain" in r.headers["Content-Type"]
+        text = r.read().decode()
+    # non-trivial exposition: the families SERVING.md documents are live
+    for name in ("raft_serving_requests_total",
+                 "raft_serving_queue_depth",
+                 "raft_serving_batch_occupancy_bucket",
+                 "raft_serving_request_latency_seconds_bucket",
+                 "raft_serving_compile_cache_misses_total",
+                 "raft_serving_compile_cache_entries",
+                 "raft_serving_queue_limit"):
+        assert name in text, name
+    assert 'raft_serving_requests_total{status="ok"}' in text
+    assert "raft_serving_compile_cache_misses_total 0" in text
+
+
+def test_http_engine_failure_returns_500_not_dropped_socket():
+    """An engine exception must surface as HTTP 500 JSON (counted as
+    status=error where the batch died), not a reset connection; and the
+    queue-depth gauge is a live callback, not a stale snapshot."""
+    eng = StubEngine(fail=True)
+    sconfig = ServeConfig(buckets=((32, 48),), max_batch=2,
+                          max_wait_ms=5.0, queue_depth=4, port=0)
+    server = FlowServer(None, None, sconfig, engine=eng)
+    server.start()
+    try:
+        im = np.zeros((32, 48, 3)).tolist()
+        req = urllib.request.Request(
+            server.url + "/v1/flow",
+            data=json.dumps({"image1": im, "image2": im}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 500
+        assert "engine exploded" in json.loads(ei.value.read())["error"]
+        with urllib.request.urlopen(server.url + "/metrics") as r:
+            text = r.read().decode()
+        assert 'raft_serving_requests_total{status="error"} 1' in text
+        assert "raft_serving_queue_depth 0" in text   # live callback gauge
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------- CLI wiring --
+
+def test_serve_cli_rejects_bad_buckets(capsys):
+    from raft_tpu import cli
+    rc = cli.main(["-m", "serve", "--small", "--buckets", "33x48"])
+    assert rc == 2
+    assert "multiples of 8" in capsys.readouterr().out
+
+
+def test_serve_bench_importable_and_parses_prom():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(os.path.dirname(__file__), "..",
+                                    "tools", "serve_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    prom = mod.parse_prom(
+        '# HELP x y\nfoo 3\nbar{a="b"} 2.5\nbaz_bucket{le="+Inf"} 7\n')
+    assert prom == {"foo": 3.0, 'bar{a="b"}': 2.5,
+                    'baz_bucket{le="+Inf"}': 7.0}

@@ -94,7 +94,9 @@ def main() -> int:
     # -- 1. compiler-reported memory, accum 1 vs accum N ------------------
     mem = {}
     keep = {}                     # reuse the accum-N executable in stage 2
-    for accum in (1, args.accum):
+    # dedupe: --accum 1 would otherwise compile and emit the identical
+    # configuration twice (ADVICE r5)
+    for accum in dict.fromkeys((1, args.accum)):
         _, _, state, step = build(accum)
         t0 = time.perf_counter()
         compiled = step.lower(
@@ -125,6 +127,12 @@ def main() -> int:
         _emit({"stage": "memory_ratio",
                "temp_reduction_accum": round(mem[1] / mem[args.accum], 2),
                "note": f"XLA temp memory, accum 1 vs {args.accum}"},
+              args.out)
+    else:
+        _emit({"stage": "memory_ratio", "skipped": True,
+               "note": ("only one accum configuration ran (--accum 1)"
+                        if args.accum == 1 else
+                        "memory analysis unavailable on this backend")},
               args.out)
 
     # -- 2. one executed step at the recipe shape -------------------------

@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""Load generator for the serving stack: ``python tools/serve_bench.py``.
+
+Drives a FlowServer over real HTTP (keep-alive http.client connections,
+npz request bodies — the cheap client path) in either loop mode:
+
+* ``--mode closed`` (default): C client threads, each back-to-back — the
+  classic saturation probe; concurrency IS the offered load.
+* ``--mode open``: Poisson arrivals at ``--rate`` req/s dispatched to a
+  worker pool — the tail-latency probe; overload shows up as 429 shed
+  counts instead of coordinated-omission-flattered latencies.
+
+By default the server runs in-process (same flags as ``-m serve``:
+buckets / max-batch / max-wait / queue-depth); ``--url`` points at an
+already-running external server instead.  Results — p50/p95/p99/mean
+latency, pairs/sec, batch occupancy, shed/timeout counts, and the
+no-recompile check (compile misses after warmup must be 0) — are printed
+and appended to ``BENCH_serving.json`` (one JSON object per line).
+
+``--smoke`` is the CI fast path: tiny model, tiny bucket, a few dozen
+requests; exits nonzero if the batcher never coalesced (occupancy <= 1)
+or anything recompiled after warmup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import io
+import json
+import os
+import re
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_prom(text: str):
+    """Minimal Prometheus text parser: 'name{labels}' -> float."""
+    out = {}
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        m = re.match(r"^(\S+?)(\{[^}]*\})?\s+(\S+)$", ln)
+        if m:
+            out[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+    return out
+
+
+class Client:
+    """One keep-alive connection + the shared accounting."""
+
+    def __init__(self, host, port, body, results, lock):
+        self.conn = http.client.HTTPConnection(host, port, timeout=60)
+        self.body = body
+        self.results = results        # list of (status, latency_s)
+        self.lock = lock
+
+    def one(self, deadline_ms=None):
+        t0 = time.monotonic()
+        try:
+            self.conn.request(
+                "POST", "/v1/flow", body=self.body,
+                headers={"Content-Type": "application/octet-stream",
+                         "Accept": "application/octet-stream"})
+            resp = self.conn.getresponse()
+            resp.read()
+            status = resp.status
+        except Exception:
+            self.conn.close()
+            self.conn = http.client.HTTPConnection(
+                self.conn.host, self.conn.port, timeout=60)
+            status = -1
+        with self.lock:
+            self.results.append((status, time.monotonic() - t0))
+
+
+def run_closed(host, port, body, clients, total):
+    results, lock = [], threading.Lock()
+    remaining = [total]
+
+    def worker():
+        c = Client(host, port, body, results, lock)
+        while True:
+            with lock:
+                if remaining[0] <= 0:
+                    return
+                remaining[0] -= 1
+            c.one()
+
+    threads = [threading.Thread(target=worker) for _ in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, time.monotonic() - t0
+
+
+def run_open(host, port, body, clients, total, rate, seed=0):
+    """Poisson arrivals at ``rate`` req/s; a slot queue of worker threads
+    sends them.  If every worker is busy when an arrival fires, it waits —
+    the server's own queue/shedding is what we're measuring, so workers
+    are provisioned generously (clients)."""
+    import queue as _q
+    results, lock = [], threading.Lock()
+    jobs = _q.Queue()
+
+    def worker():
+        c = Client(host, port, body, results, lock)
+        while True:
+            item = jobs.get()
+            if item is None:
+                return
+            c.one()
+
+    threads = [threading.Thread(target=worker) for _ in range(clients)]
+    for t in threads:
+        t.start()
+    rng = np.random.RandomState(seed)
+    t0 = time.monotonic()
+    next_t = t0
+    for _ in range(total):
+        next_t += rng.exponential(1.0 / rate)
+        delay = next_t - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        jobs.put(1)
+    for _ in threads:
+        jobs.put(None)
+    for t in threads:
+        t.join()
+    return results, time.monotonic() - t0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description="serving load generator")
+    p.add_argument("--url", default=None,
+                   help="bench an external server (default: in-process)")
+    p.add_argument("--mode", default="closed", choices=["closed", "open"])
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--rate", type=float, default=20.0,
+                   help="open-loop arrival rate, req/s")
+    p.add_argument("--size", type=int, nargs=2, default=(96, 128),
+                   metavar=("H", "W"), help="client image size")
+    # in-process server knobs (mirror -m serve)
+    p.add_argument("--buckets", default=None, metavar="HxW,HxW",
+                   help="default: the --size rounded up to /8")
+    p.add_argument("--max-batch", type=int, default=4)
+    p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--queue-depth", type=int, default=64)
+    p.add_argument("--deadline-ms", type=float, default=10000.0)
+    p.add_argument("--small", action="store_true", default=None)
+    p.add_argument("--iters", type=int, default=None)
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--out", default="BENCH_serving.json")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI fast path: tiny model + a few requests, "
+                        "asserts coalescing and zero recompiles")
+    args = p.parse_args()
+
+    if args.smoke:
+        args.small = True
+        args.iters = args.iters or 2
+        args.size = (32, 48)
+        args.requests = min(args.requests, 24)
+        args.clients = min(args.clients, 4)
+        args.cpu = True
+    if args.cpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    h, w = args.size
+    rng = np.random.RandomState(0)
+    im1 = rng.rand(h, w, 3).astype(np.float32)
+    im2 = np.clip(im1 + rng.randn(h, w, 3).astype(np.float32) * 0.05, 0, 1)
+    buf = io.BytesIO()
+    np.savez(buf, image1=im1, image2=im2)
+    body = buf.getvalue()
+
+    server = None
+    if args.url:
+        m = re.match(r"https?://([^:/]+):(\d+)", args.url)
+        if not m:
+            print(f"ERROR: --url must look like http://host:port, "
+                  f"got {args.url!r}")
+            return 2
+        host, port = m.group(1), int(m.group(2))
+    else:
+        from raft_tpu.config import RAFTConfig, init_rng
+        from raft_tpu.models import init_raft
+        from raft_tpu.serving import FlowServer, ServeConfig, parse_buckets
+
+        bucket_spec = args.buckets or f"{-(-h // 8) * 8}x{-(-w // 8) * 8}"
+        config = (RAFTConfig.small_model(iters=args.iters)
+                  if args.small else
+                  RAFTConfig.full(iters=args.iters or 12))
+        params = init_raft(init_rng(), config)
+        sconfig = ServeConfig(
+            buckets=parse_buckets(bucket_spec), max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms, queue_depth=args.queue_depth,
+            default_deadline_ms=args.deadline_ms, port=0)
+        server = FlowServer(config, params, sconfig, verbose=False)
+        t0 = time.monotonic()
+        server.start()
+        print(f"[bench] in-process server ready in "
+              f"{time.monotonic() - t0:.1f}s  buckets={bucket_spec}  "
+              f"max_batch={args.max_batch}  url={server.url}")
+        host, port = sconfig.host, server.port
+
+    if args.mode == "closed":
+        results, elapsed = run_closed(host, port, body,
+                                      args.clients, args.requests)
+    else:
+        results, elapsed = run_open(host, port, body, args.clients,
+                                    args.requests, args.rate)
+
+    # scrape the server's own view before shutdown
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    conn.request("GET", "/metrics")
+    prom = parse_prom(conn.getresponse().read().decode())
+    conn.close()
+    if server is not None:
+        server.stop()
+
+    ok_lat = sorted(lat for st, lat in results if st == 200)
+    by_status = {}
+    for st, _ in results:
+        by_status[str(st)] = by_status.get(str(st), 0) + 1
+    occ_count = prom.get("raft_serving_batch_occupancy_count", 0)
+    occ_mean = (prom.get("raft_serving_batch_occupancy_sum", 0) / occ_count
+                if occ_count else 0.0)
+    bs_count = prom.get("raft_serving_batch_size_count", 0)
+    bs_mean = (prom.get("raft_serving_batch_size_sum", 0) / bs_count
+               if bs_count else 0.0)
+    pct = (lambda q: float(np.percentile(ok_lat, q)) * 1000) if ok_lat \
+        else (lambda q: float("nan"))
+    rec = {
+        "bench": "serving", "mode": args.mode,
+        "clients": args.clients, "requests": args.requests,
+        "rate_rps": args.rate if args.mode == "open" else None,
+        "image_hw": [h, w], "max_batch": args.max_batch,
+        "max_wait_ms": args.max_wait_ms, "queue_depth": args.queue_depth,
+        "statuses": by_status, "elapsed_s": round(elapsed, 3),
+        "pairs_per_sec": round(len(ok_lat) / elapsed, 3) if elapsed else 0.0,
+        "latency_ms": {"p50": round(pct(50), 2), "p95": round(pct(95), 2),
+                       "p99": round(pct(99), 2),
+                       "mean": round(float(np.mean(ok_lat)) * 1000, 2)
+                       if ok_lat else float("nan")},
+        "batch_size_mean": round(bs_mean, 3),
+        "batch_occupancy_mean": round(occ_mean, 3),
+        "batches": int(bs_count),
+        "compile_misses_after_warmup": int(
+            prom.get("raft_serving_compile_cache_misses_total", -1)),
+        "timed_out": int(prom.get(
+            'raft_serving_requests_total{status="timeout"}', 0)),
+        "shed_429": int(prom.get(
+            'raft_serving_requests_total{status="shed"}', 0)),
+    }
+    print(json.dumps(rec, indent=2))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"[bench] appended to {args.out}")
+
+    if args.smoke:
+        problems = []
+        if not ok_lat:
+            problems.append("no successful requests")
+        if rec["batch_size_mean"] <= 1.0 and args.clients > 1:
+            problems.append(f"batcher never coalesced "
+                            f"(mean batch {rec['batch_size_mean']})")
+        if rec["compile_misses_after_warmup"] != 0:
+            problems.append(f"{rec['compile_misses_after_warmup']} "
+                            f"compile(s) after warmup")
+        if problems:
+            print("[bench] SMOKE FAIL: " + "; ".join(problems))
+            return 1
+        print("[bench] SMOKE PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
