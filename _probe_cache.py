@@ -1,0 +1,115 @@
+"""Cross-process cache of the TPU-tunnel probe verdict.
+
+``bench.py`` (and anything else that must not wedge on the axon tunnel)
+probes the TPU backend in a throwaway subprocess before touching it
+in-process — 90 s x 3 retries when the tunnel is dead (BENCH_r05: every
+tool invocation of a session re-paid the full 4.5+ minutes).  This module
+makes the verdict a per-session cost instead of a per-process one: the
+first process writes its verdict to a /tmp stamp file, later processes read
+it back and skip the probe while it is fresh.
+
+Policy:
+
+* A DOWN verdict is cached for ``TTL_DOWN`` (default 15 min — the tunnel
+  has stayed down for multi-hour stretches; a dead session should not
+  re-probe every tool run, but a recovering tunnel is noticed within the
+  TTL).  An UP verdict is cached for ``TTL_UP`` (default 5 min) and only
+  SHORTENS the next probe, never skips it: the stamp is cross-process and
+  may be minutes stale, and unprobed in-process init over a tunnel that
+  dropped in the meantime hangs forever — the exact mode the probe
+  guards against.
+* ``RAFT_TPU_SKIP_PROBE`` overrides the cache entirely:
+  ``1``/``up``/``ok``/``yes``/``true`` -> trust the backend without probing (for
+  direct-attached hardware where the 90 s probe is pure overhead);
+  ``down``/``cpu`` -> treat the backend as unavailable without probing
+  (pin a known-dead session to the CPU fallback).  Anything else —
+  including ``off``, which reads as 'no override' — warns and probes
+  normally; a typo must not disable the hang guard.
+* ``RAFT_TPU_PROBE_STAMP`` relocates the stamp file (tests point it at a
+  tmpdir; parallel CI sandboxes get isolation for free via the default's
+  uid suffix).
+
+Stdlib-only on purpose: the bench robustness contract says the JSON line
+must go out on every exit path, so this module must import even in a
+broken environment.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Optional, Tuple
+
+ENV_SKIP = "RAFT_TPU_SKIP_PROBE"
+ENV_STAMP = "RAFT_TPU_PROBE_STAMP"
+TTL_UP = 300.0
+TTL_DOWN = 900.0
+
+
+def stamp_path() -> str:
+    custom = os.environ.get(ENV_STAMP)
+    if custom:
+        return custom
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return f"/tmp/raft_tpu_probe_{uid}.json"
+
+
+def env_skip() -> Tuple[bool, Optional[str]]:
+    """The ``RAFT_TPU_SKIP_PROBE`` override as ``(skip, verdict)``.
+
+    ``skip`` False -> no override (probe normally, honoring the stamp).
+    ``skip`` True with verdict None -> trust the backend without probing;
+    with a verdict string -> treat the backend as unavailable (the string
+    describes why, for the bench JSON's error field).
+    """
+    v = os.environ.get(ENV_SKIP, "").strip().lower()
+    if v in ("", "0", "no", "false"):
+        return False, None
+    if v in ("down", "cpu"):
+        return True, f"{ENV_SKIP}={v} pins the CPU fallback"
+    if v in ("1", "up", "ok", "yes", "true"):
+        return True, None
+    # An unrecognized token must NOT fall through to trust-the-backend —
+    # that disables the hang guard entirely, the most dangerous reading.
+    # ('off' lands here on purpose: every other off-flavored token means
+    # 'no override', so pinning the CPU on it would be a trap.)  Warn and
+    # probe normally instead.
+    print(f"# {ENV_SKIP}={v!r} not recognized "
+          f"(up: 1/up/ok/yes/true; down: down/cpu); probing normally",
+          file=sys.stderr)
+    return False, None
+
+
+def cached_verdict() -> Tuple[bool, Optional[str]]:
+    """Read the stamp: ``(hit, verdict)`` — verdict None means a fresh UP
+    stamp, a string means a fresh DOWN stamp (the probe's description);
+    ``hit`` False when there is no stamp or it has expired/corrupted."""
+    try:
+        with open(stamp_path(), "r", encoding="utf-8") as f:
+            data = json.load(f)
+        if not isinstance(data, dict):      # e.g. a stamp containing `null`
+            return False, None
+        verdict = data.get("verdict")
+        age = time.time() - float(data.get("time", 0.0))
+    except (OSError, ValueError, TypeError):
+        return False, None
+    if verdict is not None and not isinstance(verdict, str):
+        return False, None
+    ttl = TTL_UP if verdict is None else TTL_DOWN
+    if not 0.0 <= age <= ttl:
+        return False, None
+    return True, verdict
+
+
+def record_verdict(verdict: Optional[str]) -> None:
+    """Write the stamp (None = backend usable).  Best-effort: a read-only
+    /tmp must not break the caller."""
+    try:
+        tmp = f"{stamp_path()}.{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"verdict": verdict, "time": time.time()}, f)
+        os.replace(tmp, stamp_path())
+    except OSError:
+        pass

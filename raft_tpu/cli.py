@@ -68,6 +68,16 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="precompute the GRU gate convs' context terms outside "
                         "the iteration loop (exact rewrite; default ON from "
                         "measured A/Bs — --no-ctx-hoist disables; TUNING.md)")
+    p.add_argument("--gru-impl", default=None, choices=["xla", "pallas"],
+                   help="SepConvGRU execution (full model): 'pallas' runs "
+                        "each GRU iteration as ONE fused VMEM-resident "
+                        "kernel (ops/gru_pallas.py; implies ctx hoisting; "
+                        "off-TPU its XLA twin runs), 'xla' the conv "
+                        "formulation (default)")
+    p.add_argument("--gru-block-rows", type=int, default=None, metavar="T",
+                   help="fused-GRU kernel: output rows per grid program "
+                        "(default 8; tools/tune_pallas.py --kernel gru "
+                        "sweeps it)")
     p.add_argument("--rgb", action="store_true",
                    help="input is RGB (default BGR, matching the reference)")
     p.add_argument("--save-flo", action="store_true", help="also write .flo")
@@ -260,6 +270,12 @@ def _make_config(args):
     overrides = dict(corr_impl=args.corr_impl, compute_dtype=dtype)
     if args.ctx_hoist is not None:       # tri-state: None = config default
         overrides["gru_ctx_hoist"] = args.ctx_hoist
+    # getattr: programmatic callers (tests, serving harnesses) build
+    # Namespaces by hand and may predate these flags
+    if getattr(args, "gru_impl", None) is not None:
+        overrides["gru_impl"] = args.gru_impl
+    if getattr(args, "gru_block_rows", None) is not None:
+        overrides["gru_block_rows"] = args.gru_block_rows
     if args.corr_lookup is not None:
         overrides["corr_lookup"] = args.corr_lookup
     if args.iters is not None:

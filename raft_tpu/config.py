@@ -115,6 +115,23 @@ class RAFTConfig:
     # so it can only help more where the gate convs dominate (round-2 TPU
     # attribution).  TPU confirmation stage queued in tools/hw_queue.sh.
     gru_ctx_hoist: bool = True
+    # Which implementation executes the SepConvGRU iteration (full model
+    # only — the small variant's 3x3 ConvGRU has no hand kernel yet):
+    # 'xla' = the conv formulation above (with optional ctx hoisting);
+    # 'pallas' = the fused update-block kernel (ops/gru_pallas.py): one
+    # grid pass per iteration keeps h, motion, the hoisted context terms
+    # and all gate weights VMEM-resident — the 1x5 and 5x1 gate passes,
+    # nonlinearities and blends never round-trip HBM.  Implies the ctx
+    # hoist (the kernel consumes precomputed context terms).  Off-TPU the
+    # kernel's XLA twin runs (same fused weights, f32-compute policy —
+    # measured faster than the emulated-bf16 conv path on CPU, PERF.md r6);
+    # interpret mode covers the literal kernel body in tests.
+    gru_impl: str = "xla"
+    # Output rows per grid program of the fused GRU kernel (the pass-1
+    # recompute halo is 4 rows, so larger blocks amortize more halo
+    # recompute at more VMEM).  Sweep: tools/tune_pallas.py --kernel gru;
+    # hardware numbers pending (TUNING.md round 6).
+    gru_block_rows: int = 8
 
     @property
     def fnet_dim(self) -> int:

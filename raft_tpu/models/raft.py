@@ -95,7 +95,27 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
     iters = config.iters if iters is None else iters
     all_flows = train if all_flows is None else all_flows
     cnet_norm = "none" if config.small else "batch"
-    update_fn = apply_small_update_block if config.small else apply_basic_update_block
+    if config.gru_impl not in ("xla", "pallas"):
+        # same silent-fallback hazard as corr_lookup: a typo must not
+        # quietly run the other GRU implementation
+        raise ValueError(f"gru_impl must be 'xla' or 'pallas', "
+                         f"got {config.gru_impl!r}")
+    if config.gru_impl == "pallas" and config.small:
+        raise ValueError(
+            "gru_impl='pallas' covers the full model's SepConvGRU; the "
+            "small variant's 3x3 ConvGRU has no hand kernel — use "
+            "gru_impl='xla'.")
+    if config.gru_impl == "pallas" and spmd.spatial_axis() is not None:
+        raise NotImplementedError(
+            "gru_impl='pallas' under row-sharded (spatial) execution is not "
+            "wired: the kernel's row halo does not exchange across shards; "
+            "use gru_impl='xla' (conv2d halo-exchanges automatically).")
+    if config.small:
+        update_fn = apply_small_update_block
+    else:
+        update_fn = functools.partial(apply_basic_update_block,
+                                      gru_impl=config.gru_impl,
+                                      gru_block_rows=config.gru_block_rows)
     cdt = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
 
     orig_params = params
@@ -220,9 +240,11 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                                     mask.astype(jnp.float32))
 
     gru_ctx = None
-    if config.gru_ctx_hoist:
+    if config.gru_ctx_hoist or config.gru_impl == "pallas":
         # context terms of the gate convs are iteration-invariant: one conv
-        # each here instead of a third of every in-loop gate contraction
+        # each here instead of a third of every in-loop gate contraction.
+        # gru_impl='pallas' requires them regardless of the hoist flag (the
+        # fused kernel never contracts the context channels in-loop).
         gru_ctx = precompute_gru_ctx(params["update_block"]["gru"], inp,
                                      config.hidden_dim, small=config.small)
 

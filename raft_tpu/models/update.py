@@ -229,10 +229,29 @@ def init_basic_update_block(key, corr_dim: int, hidden_dim: int = 128,
 
 def apply_basic_update_block(p: dict, net: jax.Array, inp: jax.Array,
                              corr: jax.Array, flow: jax.Array,
-                             gru_ctx: Optional[dict] = None
+                             gru_ctx: Optional[dict] = None,
+                             gru_impl: str = "xla",
+                             gru_block_rows: int = 8
                              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    if gru_impl not in ("xla", "pallas"):
+        # public entry point (models/__init__, tools/profile_breakdown):
+        # a typo must not quietly run the other GRU implementation
+        raise ValueError(f"gru_impl must be 'xla' or 'pallas', "
+                         f"got {gru_impl!r}")
     motion = apply_basic_motion_encoder(p["encoder"], flow, corr)
-    if gru_ctx is not None:      # inp's gate-conv terms precomputed outside
+    if gru_impl == "pallas":
+        # fused update-block kernel (ops/gru_pallas.py): one VMEM-resident
+        # grid pass per iteration; requires the hoisted context terms
+        # (raft_forward precomputes them whenever gru_impl='pallas', even
+        # with gru_ctx_hoist off).  Lazy import: the XLA path must not pay
+        # a Pallas import.
+        if gru_ctx is None:
+            raise ValueError("gru_impl='pallas' needs the hoisted context "
+                             "terms: pass gru_ctx=precompute_gru_ctx(...)")
+        from ..ops.gru_pallas import sep_conv_gru_pallas
+        net = sep_conv_gru_pallas(p["gru"], net, motion, gru_ctx,
+                                  block_rows=gru_block_rows)
+    elif gru_ctx is not None:    # inp's gate-conv terms precomputed outside
         net = apply_sep_conv_gru_hoisted(p["gru"], net, motion, gru_ctx)
     else:
         x = jnp.concatenate([inp, motion], -1)

@@ -28,7 +28,7 @@ TPU-first design, not a translation:
 from __future__ import annotations
 
 import functools
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -192,38 +192,59 @@ def lookup_dense_onehot(pyramid: Sequence[jax.Array], coords: jax.Array,
 
 
 def _gather_feature_windows(fmap: jax.Array, ix0: jax.Array, iy0: jax.Array, win: int) -> jax.Array:
-    """fmap: [B, H, W, C]; ix0/iy0: [B, T] -> [B, T, win(y), win(x), C], zeros OOB."""
+    """fmap: [B, H, W, C]; ix0/iy0: [B, T] -> [B, T, win(y), win(x), C], zeros OOB.
+
+    One flat gather over the H*W plane of exactly the T*win^2 window points.
+    An earlier two-stage version (row gather then column gather)
+    materialized a [B, T*win, W, C] intermediate — ~W/win x larger than the
+    output, hundreds of MB at chunk 1024 — which made the gather-lookup
+    blockwise path the one degenerate CPU config in BENCH_r05 (0.515 vs
+    1.898 pairs/s for its one-hot sibling).
+    """
     B, H, W, C = fmap.shape
     offs = jnp.arange(win, dtype=jnp.int32)
-    iy = iy0[..., None] + offs
+    iy = iy0[..., None] + offs                       # [B, T, win]
     ix = ix0[..., None] + offs
-    valid_y = (iy >= 0) & (iy < H)
-    valid_x = (ix >= 0) & (ix < W)
-    iyc = jnp.clip(iy, 0, H - 1)
-    ixc = jnp.clip(ix, 0, W - 1)
-    # rows: [B, H, W, C] -> [B, T*win, W, C] via flat gather on H axis
-    rows = jnp.take_along_axis(fmap, iyc.reshape(B, -1, 1, 1), axis=1)
-    rows = jnp.where(valid_y.reshape(B, -1, 1, 1), rows, 0.0)   # [B, T*win, W, C]
-    rows = rows.reshape(B, iy.shape[1], win, W, C)
-    # cols: gather W axis with per-query x indices
-    cols = jnp.take_along_axis(rows, ixc[:, :, None, :, None], axis=3)
-    cols = jnp.where(valid_x[:, :, None, :, None], cols, 0.0)
-    return cols  # [B, T, win(y), win(x), C]
+    valid = ((iy >= 0) & (iy < H))[..., :, None] & \
+            ((ix >= 0) & (ix < W))[..., None, :]     # [B, T, win(y), win(x)]
+    flat = (jnp.clip(iy, 0, H - 1)[..., :, None] * W
+            + jnp.clip(ix, 0, W - 1)[..., None, :])  # [B, T, win, win]
+    T = iy.shape[1]
+    pts = jnp.take_along_axis(fmap.reshape(B, H * W, C),
+                              flat.reshape(B, T * win * win, 1), axis=1)
+    return jnp.where(valid[..., None], pts.reshape(B, T, win, win, C), 0.0)
 
 
 @contract(fmap1="*[B,H,W,C]", coords="*[B,H,W,2]", _returns="f32[B,H,W,N]")
 def lookup_ondemand(fmap1: jax.Array, fmap2_levels: Sequence[jax.Array],
-                    coords: jax.Array, radius: int, chunk: int = 1024,
+                    coords: jax.Array, radius: int,
+                    chunk: Optional[int] = None,
                     precision=None) -> jax.Array:
     """Blockwise correlation lookup without any (HW)^2 volume.
 
     For each query chunk and level: gather the (2r+2)^2 fmap2 feature window,
     contract with the query's fmap1 vector on the MXU, combine bilinearly.
+
+    ``chunk`` (queries per ``lax.map`` step) defaults to a cache-budgeted
+    size: the live window buffer is B * chunk * (2r+2)^2 * C floats, and a
+    round-6 CPU sweep showed time tracking that buffer, not the chunk count
+    — ~7-13 MB is the sweet spot at the bench shapes while the old fixed
+    chunk=1024 ran buffers of 100-400 MB for a 3-5x slowdown (the
+    BENCH_r05 'blockwise+bf16' anomaly, 0.515 vs 1.898 pairs/s for the
+    one-hot sibling).  The path stays gather-BOUND by construction either
+    way — it is the reference SampleCorr semantics twin and the fused
+    kernel's backward-gradient oracle, not a fast path;
+    ``lookup_blockwise_onehot`` replaces the gathers with matmuls and is
+    the shipping blockwise default.
     """
     B, H, W, C = fmap1.shape
     Q = H * W
     n = 2 * radius + 1
     win = 2 * radius + 2
+    if chunk is None:
+        budget = 8 * 2 ** 20                     # ~8 MB window buffer
+        chunk = max(32, min(1024, budget // max(1, B * win * win * C * 4)))
+        chunk = 1 << (chunk.bit_length() - 1)    # pow2 so padding stays small
     f1 = fmap1.reshape(B, Q, C)
     flat = coords.reshape(B, Q, 2)
     scale = 1.0 / jnp.sqrt(jnp.asarray(C, jnp.float32))

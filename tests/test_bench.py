@@ -35,7 +35,40 @@ def test_candidate_config_mapping(name, impl, precision, lookup, style, p_select
     if p_select == "window":    # fine blocks so there is something to skip
         assert cfg.pallas_p_blk == 1024
     assert cfg.compute_dtype == "bfloat16"
+    assert cfg.gru_impl == "xla"
     assert not cfg.small
+
+
+def test_gru_candidate_config_mapping():
+    """The fused-GRU candidates: '-gru' flips gru_impl on any candidate;
+    the 'pallas-gru' prefix additionally rides the CPU-runnable
+    dense-onehot-ctx correlation path (so the CPU-fallback sweep can
+    measure the update-block kernel's twin)."""
+    cfg = _cfg_for("pallas-gru")
+    assert cfg.gru_impl == "pallas"
+    assert cfg.corr_impl == "dense"
+    assert cfg.corr_lookup == "onehot"
+    assert cfg.gru_ctx_hoist           # the kernel consumes hoisted ctx
+    assert cfg.corr_precision == "highest"
+
+    cfg = _cfg_for("pallas-bf16corr-ctx-gru")
+    assert cfg.gru_impl == "pallas"
+    assert cfg.corr_impl == "pallas"
+    assert cfg.corr_precision == "default"
+    assert cfg.gru_ctx_hoist
+
+
+def test_cpu_fallback_keeps_pallas_gru():
+    """Off-TPU the corr-kernel candidates are dropped (interpret mode) but
+    pallas-gru must survive the filter — its GRU runs the XLA twin — and
+    ctx-hoisted configs sort first."""
+    from bench import _cpu_candidates
+
+    kept = _cpu_candidates(["pallas-bf16corr-ctx-gru", "pallas-bf16corr",
+                            "pallas-gru", "dense-onehot", "dense-onehot-ctx",
+                            "blockwise"])
+    assert kept == ["pallas-gru", "dense-onehot-ctx", "dense-onehot",
+                    "blockwise"]
 
 
 @pytest.mark.slow
@@ -68,3 +101,104 @@ def test_peak_flops_table():
     assert _peak_flops("TPU v5 lite") == pytest.approx(197e12)
     assert _peak_flops("TPU v4") == pytest.approx(275e12)
     assert _peak_flops("cpu") is None
+
+
+# ------------------------- TPU probe verdict cache (_probe_cache.py) ----
+
+def test_probe_cache_roundtrip(tmp_path, monkeypatch):
+    import _probe_cache as pc
+
+    monkeypatch.setenv(pc.ENV_STAMP, str(tmp_path / "stamp.json"))
+    assert pc.cached_verdict() == (False, None)          # no stamp yet
+    pc.record_verdict("backend init hung > 90s")
+    assert pc.cached_verdict() == (True, "backend init hung > 90s")
+    pc.record_verdict(None)                              # UP overwrites DOWN
+    assert pc.cached_verdict() == (True, None)
+
+
+def test_probe_cache_ttl_expiry(tmp_path, monkeypatch):
+    import json
+    import time
+
+    import _probe_cache as pc
+
+    stamp = tmp_path / "stamp.json"
+    monkeypatch.setenv(pc.ENV_STAMP, str(stamp))
+    stamp.write_text(json.dumps({"verdict": "down",
+                                 "time": time.time() - pc.TTL_DOWN - 1}))
+    assert pc.cached_verdict() == (False, None)          # expired
+    stamp.write_text(json.dumps({"verdict": None,
+                                 "time": time.time() - pc.TTL_UP - 1}))
+    assert pc.cached_verdict() == (False, None)
+    # a clock that jumped backwards must not make a stamp immortal
+    stamp.write_text(json.dumps({"verdict": "down",
+                                 "time": time.time() + 3600}))
+    assert pc.cached_verdict() == (False, None)
+    stamp.write_text("not json{")                        # corrupted stamp
+    assert pc.cached_verdict() == (False, None)
+    stamp.write_text("null")                             # valid JSON, not a dict
+    assert pc.cached_verdict() == (False, None)
+
+
+def test_probe_cache_env_skip(monkeypatch):
+    import _probe_cache as pc
+
+    monkeypatch.delenv(pc.ENV_SKIP, raising=False)
+    assert pc.env_skip() == (False, None)
+    monkeypatch.setenv(pc.ENV_SKIP, "1")
+    assert pc.env_skip() == (True, None)                 # trust the backend
+    monkeypatch.setenv(pc.ENV_SKIP, "cpu")
+    skip, verdict = pc.env_skip()
+    assert skip and "RAFT_TPU_SKIP_PROBE" in verdict     # pin CPU fallback
+    monkeypatch.setenv(pc.ENV_SKIP, "0")
+    assert pc.env_skip() == (False, None)
+    # a typo must NOT read as trust-the-backend — that would disable the
+    # hang guard; it falls back to probing normally.  'off' lands here
+    # too: every other off-flavored token means 'no override', so pinning
+    # the CPU on it would be a trap.
+    monkeypatch.setenv(pc.ENV_SKIP, "offf")
+    assert pc.env_skip() == (False, None)
+    monkeypatch.setenv(pc.ENV_SKIP, "off")
+    assert pc.env_skip() == (False, None)
+
+
+def test_init_device_probes_despite_fresh_up_stamp(tmp_path, monkeypatch):
+    """A fresh UP stamp shortens the probe but must never skip it: the
+    stamp is cross-process and up to TTL_UP stale, and unprobed in-process
+    init over a dropped tunnel is the indefinite-hang mode."""
+    import _probe_cache as pc
+    import bench
+
+    monkeypatch.setenv(pc.ENV_STAMP, str(tmp_path / "stamp.json"))
+    monkeypatch.delenv(pc.ENV_SKIP, raising=False)
+    pc.record_verdict(None)                              # fresh UP stamp
+
+    timeouts = []
+
+    def _probe(timeout_s):
+        timeouts.append(timeout_s)
+        return None                                      # probe says UP
+
+    monkeypatch.setattr(bench, "_probe_tpu", _probe)
+    dev, err = bench._init_device(force_cpu=False)
+    assert err is None
+    assert timeouts == [30.0]                            # probed, fast-fail
+
+
+def test_init_device_honors_cached_down_verdict(tmp_path, monkeypatch):
+    """A fresh DOWN stamp must route _init_device straight to the CPU
+    fallback without spawning any probe subprocess."""
+    import _probe_cache as pc
+    import bench
+
+    monkeypatch.setenv(pc.ENV_STAMP, str(tmp_path / "stamp.json"))
+    monkeypatch.delenv(pc.ENV_SKIP, raising=False)
+    pc.record_verdict("backend init hung > 90s")
+
+    def _no_probe(timeout_s):
+        raise AssertionError("probe subprocess must not run on a cached DOWN")
+
+    monkeypatch.setattr(bench, "_probe_tpu", _no_probe)
+    dev, err = bench._init_device(force_cpu=False)
+    assert dev.platform == "cpu"
+    assert "cached probe verdict" in err

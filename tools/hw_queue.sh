@@ -66,6 +66,10 @@ run profile_train  1500 python tools/profile_breakdown.py --size 368 496 --batch
 run tune_window    1800 python tools/tune_pallas.py --quick --precision default --p-select window
 run tune_winpack   1800 python tools/tune_pallas.py --quick --precision default --p-select window --pack
 run tune_pack      1800 python tools/tune_pallas.py --quick --precision default --pack
+#    Round-6 addition: block_rows sweep of the fused SepConvGRU update
+#    kernel (the GRU-bound regime's hot stage; xla-vs-pallas per-iteration
+#    table) — hw_smoke above already gated its Mosaic lowering.
+run tune_gru       1800 python tools/tune_pallas.py --kernel gru
 # 4. Headline inference bench (writes its own JSON line).
 run bench          2400 python bench.py
 # 5. Train-step throughput at the official shape, incl. accum overhead.

@@ -1,10 +1,13 @@
-"""Real-TPU smoke for the fused-kernel variants: Mosaic compile + on-device
-parity vs the dense XLA oracle for every (p_select, pack_rows) combination.
+"""Real-TPU smoke for the fused kernels: Mosaic compile + on-device parity
+vs the XLA oracles — the corr kernel across every (p_select, pack_rows)
+combination, and the fused SepConvGRU update kernel (ops/gru_pallas.py)
+across block_rows and I/O dtypes.
 
 Interpret-mode tests prove kernel *semantics*; this proves Mosaic *lowering*
 on actual hardware (scalar-prefetch index maps, packed reshapes, pl.when
-accumulation) — run it first whenever the kernel changes, before spending
-tunnel time on sweeps.
+accumulation; for the GRU kernel: clamped neighbor-block index maps, halo
+concats/slices, the merged [rows*W, C] tap matmuls) — run it first whenever
+a kernel changes, before spending tunnel time on sweeps.
 
 Usage: python tools/hw_smoke.py [--full]   (--full adds the training shape)
 """
@@ -75,6 +78,49 @@ def main() -> int:
                 print(f"{label}  {name:<12} RAISED {type(e).__name__}: "
                       f"{str(e)[:200]}", flush=True)
                 failures += 1
+
+    # --- fused SepConvGRU update kernel (ops/gru_pallas.py): Mosaic
+    # lowering + on-device parity vs the XLA GRU oracle.  f32 I/O gates at
+    # the corr tolerance (the kernel computes f32 internally); bf16 I/O
+    # gates at bf16 resolution (the oracle itself rounds every
+    # intermediate to bf16, the kernel only at the boundary).
+    from raft_tpu.models.update import (apply_sep_conv_gru,
+                                        init_sep_conv_gru,
+                                        precompute_gru_ctx)
+    from raft_tpu.ops.gru_pallas import sep_conv_gru_pallas
+
+    hid = mdim = ctxd = 128                       # full-model channel plan
+    gru_shapes = [("eval 1x432x1024", 1, 54, 128)]
+    if args.full:
+        gru_shapes.append(("train 6x368x496", 6, 46, 62))
+    for label, B, h, w in gru_shapes:
+        ks = jax.random.split(jax.random.PRNGKey(1), 4)
+        p_gru = init_sep_conv_gru(ks[0], hid, ctxd + mdim)
+        hst = jax.random.normal(ks[1], (B, h, w, hid), jnp.float32)
+        mot = jax.random.normal(ks[2], (B, h, w, mdim), jnp.float32)
+        inp = jax.random.normal(ks[3], (B, h, w, ctxd), jnp.float32)
+        for dt, tol in ((jnp.float32, 1e-4), (jnp.bfloat16, 5e-2)):
+            pd = jax.tree.map(lambda a: a.astype(dt), p_gru)
+            hd, md, ind = hst.astype(dt), mot.astype(dt), inp.astype(dt)
+            ctx = precompute_gru_ctx(pd, ind, hid)
+            want = np.asarray(apply_sep_conv_gru(
+                pd, hd, jnp.concatenate([ind, md], -1)), np.float32)
+            for T in (8, 16):
+                name = f"gru T={T} {dt.__name__}"
+                try:
+                    got = np.asarray(sep_conv_gru_pallas(
+                        pd, hd, md, ctx, block_rows=T, interpret=False,
+                        impl="kernel"), np.float32)
+                    err = np.abs(got - want).max()
+                    ok = err < tol
+                    print(f"{label}  {name:<16} max|err|={err:.2e}  "
+                          f"{'OK' if ok else 'FAIL'}", flush=True)
+                    failures += (not ok)
+                except Exception as e:   # noqa: BLE001 — report every combo
+                    print(f"{label}  {name:<16} RAISED {type(e).__name__}: "
+                          f"{str(e)[:200]}", flush=True)
+                    failures += 1
+
     print(f"# {failures} failures", flush=True)
     return 1 if failures else 0
 

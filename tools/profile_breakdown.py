@@ -171,6 +171,45 @@ def main():
         print(f"fused lookup alone     : {dt * 1e3:8.3f} ms "
               f"(GRU-side remainder {(per_iter - dt) * 1e3:.3f} ms)")
 
+    # --- gru stage: the update operator in isolation, XLA vs the fused
+    # kernel (the GRU-bound regime's hot stage — round-2 attribution put
+    # most of the per-iteration cost here, not in the corr lookup)
+    if not cfg.small:
+        import functools
+
+        from raft_tpu.models.update import (apply_basic_update_block,
+                                            init_basic_update_block,
+                                            precompute_gru_ctx)
+
+        up = init_basic_update_block(jax.random.PRNGKey(7),
+                                     cfg.corr_feature_dim, cfg.hidden_dim,
+                                     cfg.context_dim)
+        if cfg.compute_dtype == "bfloat16":
+            up = jax.tree.map(lambda a: a.astype(jnp.bfloat16)
+                              if a.dtype == jnp.float32 else a, up)
+        net = jnp.tanh(jax.random.normal(jax.random.PRNGKey(8),
+                                         (B, h, w, cfg.hidden_dim), cdt))
+        inp = jax.nn.relu(jax.random.normal(jax.random.PRNGKey(9),
+                                            (B, h, w, cfg.context_dim), cdt))
+        corr_in = jax.random.normal(jax.random.PRNGKey(10),
+                                    (B, h, w, cfg.corr_feature_dim), cdt)
+        flow_in = jax.random.normal(jax.random.PRNGKey(11), (B, h, w, 2), cdt)
+        ctx = jax.jit(functools.partial(precompute_gru_ctx,
+                                        hidden=cfg.hidden_dim))(up["gru"], inp)
+        impls = ["xla", "pallas"]
+        for impl in impls:
+            fn = jax.jit(functools.partial(
+                apply_basic_update_block, gru_impl=impl,
+                gru_block_rows=cfg.gru_block_rows))
+            try:
+                comp = fn.lower(up, net, inp, corr_in, flow_in, ctx).compile()
+                dt = measure(comp, (up, net, inp, corr_in, flow_in, ctx))
+                print(f"update block ({impl:>6}) : {dt * 1e3:8.3f} ms "
+                      f"(motion enc + GRU + heads, 1 iteration)", flush=True)
+            except Exception as e:  # noqa: BLE001 — report, keep profiling
+                print(f"update block ({impl:>6}) : FAILED "
+                      f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -1,14 +1,22 @@
-"""Block-size sweep for the fused Pallas correlation kernel on real TPU.
+"""Block-size sweep for the fused Pallas kernels on real TPU.
 
-VERDICT round 1 #9: pick ``q_blk`` / ``p_blk_target`` defaults from measured
-data, not guesses.  Runs the per-GRU-iteration fused lookup (forward path,
-the hot op — 12-32 calls per inference) across block-size combinations at
-the two shapes that matter: the 432x1024 eval/demo resolution and the
-(368,496)-crop batch-6 training shape.  Prints a markdown table + JSON; the
-winners are recorded in TUNING.md and wired into RAFTConfig defaults.
+VERDICT round 1 #9: pick block-size defaults from measured data, not
+guesses.  Two sweeps, selected by ``--kernel``:
+
+* ``corr`` (default) — the fused correlation lookup (ops/corr_pallas.py)
+  across (q_blk, p_blk_target) combinations;
+* ``gru`` — the fused SepConvGRU update kernel (ops/gru_pallas.py) across
+  ``block_rows`` (output rows per grid program; larger blocks amortize the
+  4-row pass-1 recompute halo at more VMEM), with the XLA GRU formulation
+  timed alongside as the before/after reference.
+
+Both run at the two shapes that matter: the 432x1024 eval/demo resolution
+and the (368,496)-crop batch-6 training shape.  Prints a markdown table +
+JSON; the winners are recorded in TUNING.md and wired into RAFTConfig
+defaults.
 
 Usage (needs the TPU tunnel; refuses to 'tune' on CPU interpret mode):
-    python tools/tune_pallas.py [--quick]
+    python tools/tune_pallas.py [--quick] [--kernel corr|gru]
 """
 
 from __future__ import annotations
@@ -38,9 +46,74 @@ def _measure(fn, args, warmup=2, reps=20):
     return (time.perf_counter() - t0) / reps
 
 
+def _sweep_gru(args) -> int:
+    """block_rows sweep of the fused GRU kernel vs the XLA formulation."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.models.update import (apply_sep_conv_gru_hoisted,
+                                        init_sep_conv_gru, precompute_gru_ctx)
+    from raft_tpu.ops.gru_pallas import sep_conv_gru_pallas
+
+    dev = jax.devices()[0]
+    print(f"# device: {dev.device_kind}  kernel: gru  dtype: {args.dtype}")
+    dt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    hid, mdim, ctxd = 128, 128, 128            # full-model channel plan
+    shapes = [("eval 1x432x1024", 1, 54, 128),
+              ("train 6x368x496", 6, 46, 62)]
+    block_rows = (8, 16) if args.quick else (4, 8, 16, 32)
+
+    results = []
+    for label, B, h, w in shapes:
+        ks = jax.random.split(jax.random.PRNGKey(0), 4)
+        p_gru = jax.tree.map(
+            lambda a: a.astype(dt), init_sep_conv_gru(ks[0], hid, ctxd + mdim))
+        hst = jax.random.normal(ks[1], (B, h, w, hid), dt)
+        mot = jax.random.normal(ks[2], (B, h, w, mdim), dt)
+        inp = jax.random.normal(ks[3], (B, h, w, ctxd), dt)
+        ctx = precompute_gru_ctx(p_gru, inp, hid)
+        print(f"\n## {label}  (latent {B}x{h}x{w}, hidden {hid})")
+        print("| impl | block_rows | ms/iteration |")
+        print("|---|---|---|")
+        fn = jax.jit(apply_sep_conv_gru_hoisted)
+        dt_x = _measure(fn, (p_gru, hst, mot, ctx),
+                        reps=8 if args.quick else 20)
+        print(f"| xla (hoisted) | — | {dt_x * 1e3:.3f} |", flush=True)
+        results.append({"shape": label, "impl": "xla",
+                        "ms": round(dt_x * 1e3, 4)})
+        for T in block_rows:
+            fn = jax.jit(functools.partial(
+                sep_conv_gru_pallas, block_rows=T, interpret=False,
+                impl="kernel"))
+            try:
+                dt_k = _measure(fn, (p_gru, hst, mot, ctx),
+                                reps=8 if args.quick else 20)
+                results.append({"shape": label, "impl": "pallas",
+                                "block_rows": T, "ms": round(dt_k * 1e3, 4)})
+                print(f"| pallas | {T} | {dt_k * 1e3:.3f} |", flush=True)
+            except Exception as e:  # noqa: BLE001 — e.g. VMEM overflow combos
+                print(f"| pallas | {T} | FAILED {type(e).__name__} |",
+                      flush=True)
+        best = min((r for r in results
+                    if r["shape"] == label and r["impl"] == "pallas"),
+                   key=lambda r: r["ms"], default=None)
+        if best:
+            print(f"best for {label}: block_rows={best['block_rows']} "
+                  f"({best['ms']:.3f} ms vs xla {dt_x * 1e3:.3f} ms)")
+    print(json.dumps(results))
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--quick", action="store_true", help="fewer combos/reps")
+    p.add_argument("--kernel", default="corr", choices=["corr", "gru"],
+                   help="which fused kernel to sweep (gru = the update-block "
+                        "kernel's block_rows)")
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["float32", "bfloat16"],
+                   help="--kernel gru: I/O dtype of the swept iteration "
+                        "(the kernel computes f32 internally either way)")
     p.add_argument("--radius", type=int, default=4)
     p.add_argument("--levels", type=int, default=4)
     p.add_argument("--precision", default="highest",
@@ -64,6 +137,8 @@ def main() -> int:
         print("ERROR: tuning requires the TPU backend (interpret-mode timings "
               "are meaningless)", file=sys.stderr)
         return 2
+    if args.kernel == "gru":
+        return _sweep_gru(args)
 
     from raft_tpu.ops.coords import coords_grid
     from raft_tpu.ops.corr import fmap2_pyramid
