@@ -216,17 +216,30 @@ def _readback(x) -> float:
     return float(np.asarray(leaf.ravel()[0]))
 
 
-def _measure(fn, args, warmup: int = 2, reps: int = 10) -> float:
+def _measure(fn, args, warmup: int = 2, reps: int = 10, trace=None) -> float:
     """Wall time per call (seconds), amortized over ``reps`` back-to-back
     dispatches with a single final readback, so fixed per-call host/tunnel
-    overhead is divided by ``reps`` instead of polluting every sample."""
+    overhead is divided by ``reps`` instead of polluting every sample.
+
+    ``trace``: optional telemetry.trace.TraceWindow.  Dispatch here is
+    ASYNC (the whole point of the loop), so the device may still be
+    executing rep 0 when the host reaches rep N — the window therefore
+    opens at rep ``trace.first`` but closes only after the final readback,
+    the one true sync point; closing mid-loop would capture microseconds
+    of dispatch and none of the execution."""
     for _ in range(warmup):
         _readback(fn(*args))
     t0 = time.perf_counter()
     out = None
-    for _ in range(reps):
+    for i in range(reps):
+        if trace is not None:
+            # clamp below the window end so on_step never auto-closes the
+            # trace between async dispatches
+            trace.on_step(min(i, trace.last - 1))
         out = fn(*args)     # async dispatch; device executes serially
     _readback(out)
+    if trace is not None:
+        trace.stop()
     return (time.perf_counter() - t0) / reps
 
 
@@ -244,6 +257,11 @@ def main() -> int:
     p.add_argument("--budget", type=float, default=900.0,
                    help="wall-clock budget (s); later candidates are skipped "
                         "when exceeded (first compiles can be slow)")
+    p.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the winning "
+                        "candidate's steady-state reps (telemetry.trace)")
+    p.add_argument("--trace-steps", type=int, default=4,
+                   help="reps captured by --trace-dir (default 4)")
     args = p.parse_args()
     t_start = time.perf_counter()
 
@@ -261,6 +279,12 @@ def main() -> int:
         traceback.print_exc(file=sys.stderr)
         prior = f"{result['error']}; " if result["error"] else ""
         result["error"] = f"{prior}{type(e).__name__}: {e}"
+    if "manifest" not in result:
+        # crashed before _run stamped it (possibly before device init
+        # settled): stamp a device-less manifest rather than risk a hung
+        # jax.devices() on a dead tunnel
+        from raft_tpu.telemetry import run_manifest
+        result["manifest"] = run_manifest(mode="bench", probe_device=False)
     print(json.dumps(result), flush=True)
     return 0
 
@@ -273,6 +297,21 @@ def _run(args, t_start: float, result: dict) -> None:
     from raft_tpu.config import RAFTConfig
     from raft_tpu.models import init_raft
     from raft_tpu.models.raft import make_inference_fn
+    from raft_tpu.telemetry import Registry, config_hash, run_manifest
+    from raft_tpu.telemetry.trace import TraceWindow
+
+    # provenance: the backend is settled (probed TPU or CPU fallback), so
+    # the device query in the manifest is safe; the config hash of the
+    # winning candidate is patched in at the end
+    result["manifest"] = run_manifest(mode="bench")
+    registry = Registry()
+    m_measured = registry.counter("raft_bench_candidates_measured_total",
+                                  "Candidate configs that produced a number")
+    m_failed = registry.counter("raft_bench_candidates_failed_total",
+                                "Candidate configs that raised")
+    m_tput = registry.gauge("raft_bench_pairs_per_sec",
+                            "Measured throughput by candidate",
+                            labelnames=("candidate",))
 
     if degraded:
         result["error"] = degraded
@@ -288,7 +327,7 @@ def _run(args, t_start: float, result: dict) -> None:
 
     k1, k2 = jax.random.split(jax.random.PRNGKey(0))
 
-    def throughput(config, iters, batch=None):
+    def throughput(config, iters, batch=None, trace=None):
         """AOT-compile so the same executable yields both the timing and the
         cost_analysis flops; returns (pairs/sec, mfu|None)."""
         batch = B if batch is None else batch
@@ -297,7 +336,7 @@ def _run(args, t_start: float, result: dict) -> None:
         params = init_raft(jax.random.PRNGKey(0), config)
         fn = jax.jit(make_inference_fn(config, iters=iters))
         compiled = fn.lower(params, im1, im2).compile()
-        dt = _measure(compiled, (params, im1, im2))
+        dt = _measure(compiled, (params, im1, im2), trace=trace)
         mfu = None
         if peak:
             try:
@@ -363,9 +402,12 @@ def _run(args, t_start: float, result: dict) -> None:
             tput, mfu = throughput(_cfg_for(name), args.iters)
             print(f"# {name}+bf16: {tput:.3f} pairs/s"
                   + (f"  mfu={mfu:.3f}" if mfu else ""), file=sys.stderr)
+            m_measured.inc()
+            m_tput.labels(f"{name}+bf16").set(tput)
             if tput > best:
                 best_name, best, best_mfu = f"{name}+bf16", tput, mfu
         except Exception as e:    # noqa: BLE001 — keep benchmarking others
+            m_failed.inc()
             print(f"# {name} failed: {type(e).__name__}: {e}", file=sys.stderr)
 
     # batching sweep on the winning config (free batch size is one of the
@@ -382,20 +424,40 @@ def _run(args, t_start: float, result: dict) -> None:
                 print(f"# {best_name.split('+')[0]}+bf16 b{nb}: {tput:.3f} "
                       f"pairs/s" + (f"  mfu={mfu:.3f}" if mfu else ""),
                       file=sys.stderr)
+                m_measured.inc()
+                m_tput.labels(f"{best_name.split('+')[0]}+bf16,b{nb}").set(tput)
                 if tput > best:
                     best, best_mfu = tput, mfu
                     best_name = f"{best_name.split('+')[0]}+bf16,b{nb}"
             except Exception as e:   # noqa: BLE001 — e.g. OOM at high res
+                m_failed.inc()
                 print(f"# batch {nb} failed: {type(e).__name__}", file=sys.stderr)
                 break
 
     if best_name is None:
         raise RuntimeError("no candidate configuration completed")
+
+    if getattr(args, "trace_dir", None):
+        # one extra steady-state measurement of the winner under the
+        # profiler, so the trace shows exactly the headline configuration
+        bare, bnum = best_name.split("+")[0], B
+        if ",b" in best_name:
+            bnum = int(best_name.split(",b")[1])
+        throughput(_cfg_for(bare), args.iters, batch=bnum,
+                   trace=TraceWindow(args.trace_dir, first=0,
+                                     steps=args.trace_steps,
+                                     log_fn=lambda m: print(f"# {m}",
+                                                            file=sys.stderr)))
+
     result["metric"] = (f"raft-things inference throughput @ {args.iters} "
                         f"GRU iters, {H}x{W} ({best_name})")
     result["value"] = round(best, 4)
     result["vs_baseline"] = round(best / ref, 4) if ref else None
     result["mfu"] = round(best_mfu, 4) if best_mfu else None
+    result["manifest"]["config_hash"] = config_hash(
+        _cfg_for(best_name.split("+")[0]))
+    result["manifest"]["candidate"] = best_name
+    result["metrics"] = registry.snapshot()
 
 
 if __name__ == "__main__":

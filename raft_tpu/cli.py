@@ -96,8 +96,24 @@ def _build_parser() -> argparse.ArgumentParser:
                         "8*N*2^(corr_levels-1)")
     p.add_argument("--trace", default=None, metavar="DIR",
                    help="write a jax.profiler trace (XPlane, viewable in "
-                        "TensorBoard/Perfetto) of the steady-state run "
-                        "(test mode) or steps 5-8 (train mode)")
+                        "TensorBoard/Perfetto) of a steady-state step "
+                        "window — train: steps 5..5+N, val/serve: device "
+                        "calls after the compile, test: the second run "
+                        "(telemetry.trace.TraceWindow, OBSERVABILITY.md)")
+    p.add_argument("--trace-steps", type=int, default=None, metavar="N",
+                   help="steps/device calls captured by the --trace window "
+                        "(default 4)")
+    p.add_argument("--watchdogs", action="store_true",
+                   help="enable the telemetry watchdogs: stack-wide "
+                        "recompile counter, NaN/Inf sentinel with stage "
+                        "provenance, HBM gauges (equivalent to "
+                        "RAFT_TPU_WATCHDOGS=1 — OBSERVABILITY.md)")
+    p.add_argument("--run-log", default=None, metavar="PATH",
+                   help="run-event log: a directory (events.jsonl appended "
+                        "inside) or a .jsonl path; every mode stamps its "
+                        "manifest (git sha, jax versions, device, config "
+                        "hash) as the first record.  Default: <--out>/"
+                        "events.jsonl; 'none' disables")
     # dataset / training flags
     p.add_argument("--data", default=None, help="dataset root directory")
     p.add_argument("--dataset", default="sintel",
@@ -240,6 +256,31 @@ def _build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _start_run_log(args, config):
+    """Open this run's event log (telemetry.events) with the manifest —
+    git sha, jax/jaxlib versions, device kind + count, config hash, argv —
+    as its first record, and make it the process-wide active log so the
+    watchdogs and the training loop attach their events to it.  Every CLI
+    mode calls this right after building its config (OBSERVABILITY.md)."""
+    dest = getattr(args, "run_log", None)
+    if dest == "none":
+        return None
+    if dest is None:
+        # programmatic callers (tests, harnesses) build Namespaces by hand;
+        # no --out and no --run-log means nowhere sensible to write
+        dest = getattr(args, "out", None)
+        if not dest:
+            return None
+    from .telemetry import events, watchdogs
+    log = events.start_run(Path(dest), mode=args.mode, config=config)
+    events.set_current(log)
+    if watchdogs.watchdogs_enabled():
+        # trace-time switch: models compiled from here on carry the NaN/Inf
+        # sentinel callbacks (stage-provenanced; free when off)
+        watchdogs.enable_nan_sentinel(True, run_log=log)
+    return log
+
+
 def _make_config(args):
     from .config import RAFTConfig
     dtype = args.dtype
@@ -328,6 +369,7 @@ def mode_test(args) -> int:
     from .utils import flow_to_color, write_flo
 
     config = _make_config(args)
+    _start_run_log(args, config)
     params = _load_params(args, config)
     im1, im2 = _read_pair(args)
     if args.batch > 1:
@@ -398,6 +440,7 @@ def mode_flops(args) -> int:
     from .utils import count_params, flops_report, param_table
 
     config = _make_config(args)
+    _start_run_log(args, config)
     from .config import init_rng
     params = init_raft(init_rng(), config)
     print(param_table(params))
@@ -417,6 +460,7 @@ def mode_export(args) -> int:
     from .models.raft import make_inference_fn
 
     config = _make_config(args)
+    _start_run_log(args, config)
     params = _load_params(args, config)
     outdir = Path(args.out)
     outdir.mkdir(parents=True, exist_ok=True)
@@ -442,17 +486,23 @@ def mode_export(args) -> int:
 
 def mode_val(args) -> int:
     from .training.evaluate import evaluate_cli
-    return evaluate_cli(args, _make_config(args), _load_params)
+    config = _make_config(args)
+    _start_run_log(args, config)
+    return evaluate_cli(args, config, _load_params)
 
 
 def mode_train(args) -> int:
     from .training.loop import train_cli
-    return train_cli(args, _make_config(args))
+    config = _make_config(args)
+    _start_run_log(args, config)
+    return train_cli(args, config)
 
 
 def mode_serve(args) -> int:
     from .serving.server import serve_cli
-    return serve_cli(args, _make_config(args), _load_params)
+    config = _make_config(args)
+    _start_run_log(args, config)
+    return serve_cli(args, config, _load_params)
 
 
 def main(argv=None) -> int:
@@ -472,6 +522,11 @@ def main(argv=None) -> int:
     if args.batch is None and args.mode != "train":
         # train mode leaves None so the stage preset's batch size applies
         args.batch = 1
+    if args.watchdogs:
+        # one switch for every subsystem: the training loop, the serving
+        # stack and the model's NaN sentinel all read this env var
+        import os
+        os.environ["RAFT_TPU_WATCHDOGS"] = "1"
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")

@@ -43,7 +43,24 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from ..telemetry.registry import default_registry
+
 _SENTINEL = None
+
+
+def _loader_metrics():
+    """Counters on the process-default telemetry registry, shared across
+    loader instances (atomic get-or-create: two loaders iterated from
+    different threads must not race into a duplicate-metric error)."""
+    reg = default_registry()
+    return {
+        "samples": reg.get_or_counter(
+            "raft_data_samples_total",
+            "Samples delivered by worker-process loaders"),
+        "errors": reg.get_or_counter(
+            "raft_data_worker_errors_total",
+            "Worker failures (exception, silent death, stall)"),
+    }
 
 
 def _worker_loop(dataset, tasks, results):
@@ -122,6 +139,7 @@ class MPSampleLoader:
 
     def __iter__(self) -> Iterator:
         served = 0
+        metrics = _loader_metrics()
         last_progress = time.monotonic()
         while self._n_tasks is None or served < self._n_tasks:
             while True:
@@ -136,6 +154,7 @@ class MPSampleLoader:
                     # instead of hanging the training job forever
                     if not any(w.is_alive() for w in self._workers):
                         self.close()
+                        metrics["errors"].inc()
                         raise RuntimeError(
                             "all data workers died without reporting (killed "
                             "by the OS? check dmesg for OOM)") from None
@@ -146,6 +165,7 @@ class MPSampleLoader:
                     if (self._stall_timeout is not None
                             and stalled > self._stall_timeout):
                         self.close()
+                        metrics["errors"].inc()
                         hint = ("storage is stalled (raise stall_timeout / "
                                 "--stall-timeout, 0 disables)")
                         if self._start_method == "fork":
@@ -161,8 +181,10 @@ class MPSampleLoader:
                 continue
             if status == "error":
                 self.close()
+                metrics["errors"].inc()
                 raise RuntimeError(f"data worker failed:\n{payload}")
             served += 1
+            metrics["samples"].inc()
             yield payload
         self.close()
 

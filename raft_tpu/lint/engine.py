@@ -89,6 +89,14 @@ TRACE_ENTRIES: Dict[str, Sequence[int]] = {
     "jax.lax.while_loop": (0, 1), "jax.lax.fori_loop": (2,),
     "jax.lax.cond": (1, 2), "jax.lax.switch": (1,),
     "jax.experimental.pallas.pallas_call": (0,),
+    # this repo's version-compat shard_map wrapper (parallel/mesh.py) —
+    # every import spelling, since the engine matches resolved names
+    # exactly and relative imports resolve to the module TAIL
+    "raft_tpu.parallel.mesh.compat_shard_map": (0,),
+    "raft_tpu.parallel.compat_shard_map": (0,),
+    "parallel.mesh.compat_shard_map": (0,),
+    "mesh.compat_shard_map": (0,),
+    "compat_shard_map": (0,),
 }
 
 JIT_WRAPPERS = ("jax.jit", "jax.pmap")

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from ..ops.conv import apply_conv, init_conv
 from ..ops.norm import batch_norm, group_norm, init_batch_norm, instance_norm
+from ..telemetry.trace import stage
 
 
 def _init_norm(norm_fn: str, c: int) -> Optional[dict]:
@@ -175,19 +176,21 @@ def apply_encoder(p: dict, x: jax.Array, norm_fn: str, small: bool = False,
     bn_train = train if bn_train is None else bn_train
     block_apply = apply_bottleneck_block if small else apply_residual_block
     p = dict(p)
-    y = apply_conv(p["conv1"], x, stride=2)
-    y, n1 = _apply_norm(norm_fn, p.get("norm1"), y, bn_train, axis_name)
-    _maybe(p, "norm1", n1)
-    y = jax.nn.relu(y)
+    with stage("encoder/stem"):
+        y = apply_conv(p["conv1"], x, stride=2)
+        y, n1 = _apply_norm(norm_fn, p.get("norm1"), y, bn_train, axis_name)
+        _maybe(p, "norm1", n1)
+        y = jax.nn.relu(y)
     layer_plan = list(zip((1, 2, 3), (1, 2, 2)))
     if stages is not None:
         layer_plan = layer_plan[:stages]
     for li, stride in layer_plan:
         layer = dict(p[f"layer{li}"])
-        y, layer["0"] = block_apply(layer["0"], y, norm_fn, stride,
-                                    bn_train, axis_name)
-        y, layer["1"] = block_apply(layer["1"], y, norm_fn, 1,
-                                    bn_train, axis_name)
+        with stage(f"encoder/layer{li}"):
+            y, layer["0"] = block_apply(layer["0"], y, norm_fn, stride,
+                                        bn_train, axis_name)
+            y, layer["1"] = block_apply(layer["1"], y, norm_fn, 1,
+                                        bn_train, axis_name)
         p[f"layer{li}"] = layer
     if stages is not None:
         return y, p

@@ -33,6 +33,8 @@ from ..ops.coords import coords_grid, upflow8
 from ..ops.corr import (build_pyramid, fmap2_pyramid, lookup_blockwise_onehot,
                         lookup_dense, lookup_dense_onehot, lookup_ondemand)
 from ..ops.upsample import convex_upsample_flow
+from ..telemetry.trace import stage
+from ..telemetry.watchdogs import nan_guard
 from .encoders import apply_encoder, init_encoder
 from .update import (apply_basic_update_block, apply_small_update_block,
                      init_basic_update_block, init_small_update_block,
@@ -140,9 +142,12 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
     # Shared-weight feature encoder on both frames (reference RAFT.py:79-80):
     # batch the two frames through one encoder call so XLA sees 2B-sized convs.
     x12 = jnp.concatenate([x1, x2], axis=0)
-    fmaps, _ = apply_encoder(params["fnet"], x12, "instance", small=config.small,
-                             train=train, axis_name=axis_name,
-                             dropout=config.dropout, rng=rngs[0])
+    with stage("raft/fnet"):
+        fmaps, _ = apply_encoder(params["fnet"], x12, "instance",
+                                 small=config.small,
+                                 train=train, axis_name=axis_name,
+                                 dropout=config.dropout, rng=rngs[0])
+    fmaps = nan_guard(fmaps, "raft/fnet")
     fmap1, fmap2 = fmaps[:B], fmaps[B:]
     # correlation always in float32 (numerics policy)
     fmap1c = fmap1.astype(jnp.float32)
@@ -187,8 +192,9 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
     elif config.corr_impl == "dense":
         lookup_fn = (lookup_dense_onehot if config.corr_lookup == "onehot"
                      else lookup_dense)
-        pyramid = build_pyramid(fmap1c, fmap2c, config.corr_levels,
-                                precision=corr_prec)
+        with stage("raft/corr_pyramid"):
+            pyramid = build_pyramid(fmap1c, fmap2c, config.corr_levels,
+                                    precision=corr_prec)
         lookup = functools.partial(lookup_fn, pyramid, radius=config.corr_radius)
     elif config.corr_impl == "blockwise":
         f2_levels = fmap2_pyramid(fmap2c, config.corr_levels)
@@ -218,10 +224,11 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
     else:
         raise ValueError(config.corr_impl)
 
-    cnet, new_cnet_params = apply_encoder(
-        params["cnet"], x1, cnet_norm, small=config.small, train=train,
-        axis_name=axis_name, dropout=config.dropout, rng=rngs[1],
-        bn_train=train and not freeze_bn)
+    with stage("raft/cnet"):
+        cnet, new_cnet_params = apply_encoder(
+            params["cnet"], x1, cnet_norm, small=config.small, train=train,
+            axis_name=axis_name, dropout=config.dropout, rng=rngs[1],
+            bn_train=train and not freeze_bn)
     net = jnp.tanh(cnet[..., :config.hidden_dim])
     inp = jax.nn.relu(cnet[..., config.hidden_dim:])
 
@@ -251,12 +258,20 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
     def step(carry, _):
         net, coords1, _ = carry
         coords1 = jax.lax.stop_gradient(coords1)   # reference RAFT.py:93 / official
-        corr = lookup(coords=coords1).astype(cdt)
+        with stage("raft/corr_lookup"):
+            corr = lookup(coords=coords1).astype(cdt)
+        corr = nan_guard(corr, "raft/corr_lookup")
         flow = (coords1 - coords0).astype(cdt)
-        net, mask, delta_flow = update_fn(params["update_block"], net, inp, corr, flow,
-                                          gru_ctx=gru_ctx)
+        with stage("raft/update"):
+            net, mask, delta_flow = update_fn(params["update_block"], net, inp,
+                                              corr, flow, gru_ctx=gru_ctx)
+        delta_flow = nan_guard(delta_flow, "raft/update")
         coords1 = coords1 + delta_flow.astype(jnp.float32)
-        out = upsample(coords1 - coords0, mask) if all_flows else None
+        if all_flows:
+            with stage("raft/upsample"):
+                out = upsample(coords1 - coords0, mask)
+        else:
+            out = None
         return (net, coords1, mask), out
 
     if config.remat_iters and train:
@@ -273,7 +288,8 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
         flow = flow_iters[-1]
     else:
         flow_iters = None
-        flow = upsample(flow_lr, mask)
+        with stage("raft/upsample"):
+            flow = upsample(flow_lr, mask)
 
     new_params = dict(orig_params)
     if train and not config.small and not freeze_bn:

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.conv import apply_conv, apply_conv_fused, conv2d, init_conv
+from ..telemetry.trace import stage
 
 
 # ---------------------------------------------------------- motion encoders
@@ -238,7 +239,8 @@ def apply_basic_update_block(p: dict, net: jax.Array, inp: jax.Array,
         # a typo must not quietly run the other GRU implementation
         raise ValueError(f"gru_impl must be 'xla' or 'pallas', "
                          f"got {gru_impl!r}")
-    motion = apply_basic_motion_encoder(p["encoder"], flow, corr)
+    with stage("update/motion_encoder"):
+        motion = apply_basic_motion_encoder(p["encoder"], flow, corr)
     if gru_impl == "pallas":
         # fused update-block kernel (ops/gru_pallas.py): one VMEM-resident
         # grid pass per iteration; requires the hoisted context terms
@@ -249,18 +251,23 @@ def apply_basic_update_block(p: dict, net: jax.Array, inp: jax.Array,
             raise ValueError("gru_impl='pallas' needs the hoisted context "
                              "terms: pass gru_ctx=precompute_gru_ctx(...)")
         from ..ops.gru_pallas import sep_conv_gru_pallas
-        net = sep_conv_gru_pallas(p["gru"], net, motion, gru_ctx,
-                                  block_rows=gru_block_rows)
+        with stage("update/gru"):
+            net = sep_conv_gru_pallas(p["gru"], net, motion, gru_ctx,
+                                      block_rows=gru_block_rows)
     elif gru_ctx is not None:    # inp's gate-conv terms precomputed outside
-        net = apply_sep_conv_gru_hoisted(p["gru"], net, motion, gru_ctx)
+        with stage("update/gru"):
+            net = apply_sep_conv_gru_hoisted(p["gru"], net, motion, gru_ctx)
     else:
         x = jnp.concatenate([inp, motion], -1)
-        net = apply_sep_conv_gru(p["gru"], net, x)
+        with stage("update/gru"):
+            net = apply_sep_conv_gru(p["gru"], net, x)
     # flow head conv1 and mask head [0] both read `net` with 3x3 kernels ->
     # one fused conv (exact), then each branch's own tail
-    fh, mh = apply_conv_fused((p["flow_head"]["conv1"], p["mask"]["0"]), net)
-    delta_flow = apply_conv(p["flow_head"]["conv2"], jax.nn.relu(fh))
-    mask = MASK_SCALE * apply_conv(p["mask"]["2"], jax.nn.relu(mh))
+    with stage("update/heads"):
+        fh, mh = apply_conv_fused((p["flow_head"]["conv1"], p["mask"]["0"]),
+                                  net)
+        delta_flow = apply_conv(p["flow_head"]["conv2"], jax.nn.relu(fh))
+        mask = MASK_SCALE * apply_conv(p["mask"]["2"], jax.nn.relu(mh))
     return net, mask, delta_flow
 
 
@@ -278,11 +285,15 @@ def apply_small_update_block(p: dict, net: jax.Array, inp: jax.Array,
                              corr: jax.Array, flow: jax.Array,
                              gru_ctx: Optional[dict] = None
                              ) -> Tuple[jax.Array, Optional[jax.Array], jax.Array]:
-    motion = apply_small_motion_encoder(p["encoder"], flow, corr)
+    with stage("update/motion_encoder"):
+        motion = apply_small_motion_encoder(p["encoder"], flow, corr)
     if gru_ctx is not None:      # inp's gate-conv terms precomputed outside
-        net = apply_conv_gru_hoisted(p["gru"], net, motion, gru_ctx)
+        with stage("update/gru"):
+            net = apply_conv_gru_hoisted(p["gru"], net, motion, gru_ctx)
     else:
         x = jnp.concatenate([inp, motion], -1)
-        net = apply_conv_gru(p["gru"], net, x)
-    delta_flow = apply_flow_head(p["flow_head"], net)
+        with stage("update/gru"):
+            net = apply_conv_gru(p["gru"], net, x)
+    with stage("update/heads"):
+        delta_flow = apply_flow_head(p["flow_head"], net)
     return net, None, delta_flow

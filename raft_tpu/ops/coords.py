@@ -45,7 +45,7 @@ def _interp_rows_sharded(h_local: int, factor: int, axis_name: str) -> jax.Array
     height and this shard's offset; out-of-slab indices never match the
     one-hot comparison, and the analysis bounds every source row within the
     1-row halo."""
-    n = jax.lax.axis_size(axis_name)
+    n = spmd.axis_size(axis_name)
     s = jax.lax.axis_index(axis_name)
     hg = h_local * n
     scale = (hg - 1) / (hg * factor - 1)

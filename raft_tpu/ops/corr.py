@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from ..lint.contracts import contract
+from ..telemetry.trace import stage
 from .conv import avg_pool2d
 
 
@@ -63,8 +64,9 @@ def dense_corr(fmap1: jax.Array, fmap2_l: jax.Array,
 def build_pyramid(fmap1: jax.Array, fmap2: jax.Array, num_levels: int = 4,
                   precision=None) -> List[jax.Array]:
     """Dense correlation pyramid: list of [B, Q, H2/2^i, W2/2^i]."""
-    return [dense_corr(fmap1, f2, precision=precision)
-            for f2 in fmap2_pyramid(fmap2, num_levels)]
+    with stage("corr/pyramid"):
+        return [dense_corr(fmap1, f2, precision=precision)
+                for f2 in fmap2_pyramid(fmap2, num_levels)]
 
 
 def _window_gather_2d(vol: jax.Array, ix0: jax.Array, iy0: jax.Array, win: int) -> jax.Array:
@@ -108,6 +110,7 @@ def _bilinear_window(winv: jax.Array, fx: jax.Array, fy: jax.Array, r: int) -> j
     return out.transpose(0, 1, 3, 2).reshape(*out.shape[:2], n * n)
 
 
+@stage("corr/lookup_dense")
 @contract(coords="*[B,H,W,2]", _returns="f32[B,H,W,N]")
 def lookup_dense(pyramid: Sequence[jax.Array], coords: jax.Array, radius: int) -> jax.Array:
     """Sample the dense pyramid at ``coords`` [B, H, W, 2] (x, y).
@@ -179,6 +182,7 @@ def lookup_partial_onehot(corr3: jax.Array, coords: jax.Array, radius: int,
     return win.reshape(B, Q, n * n)
 
 
+@stage("corr/lookup_dense_onehot")
 @contract(coords="*[B,H,W,2]", _returns="f32[B,H,W,N]")
 def lookup_dense_onehot(pyramid: Sequence[jax.Array], coords: jax.Array,
                         radius: int) -> jax.Array:
@@ -215,6 +219,7 @@ def _gather_feature_windows(fmap: jax.Array, ix0: jax.Array, iy0: jax.Array, win
     return jnp.where(valid[..., None], pts.reshape(B, T, win, win, C), 0.0)
 
 
+@stage("corr/lookup_ondemand")
 @contract(fmap1="*[B,H,W,C]", coords="*[B,H,W,2]", _returns="f32[B,H,W,N]")
 def lookup_ondemand(fmap1: jax.Array, fmap2_levels: Sequence[jax.Array],
                     coords: jax.Array, radius: int,
@@ -281,6 +286,7 @@ def lookup_ondemand(fmap1: jax.Array, fmap2_levels: Sequence[jax.Array],
     return out.reshape(B, H, W, -1)
 
 
+@stage("corr/lookup_blockwise_onehot")
 @contract(fmap1="*[B,H,W,C]", coords="*[B,H,W,2]", _returns="f32[B,H,W,N]")
 def lookup_blockwise_onehot(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
                             coords: jax.Array, radius: int,

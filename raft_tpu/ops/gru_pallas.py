@@ -64,6 +64,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401 — TPU lowering
 
 from ..lint.contracts import contract
+from ..telemetry.trace import stage
 from .conv import conv2d
 
 _HALO = 4      # pass-1 recompute halo rows: q2 reads r2*h1 at +-2, r2's conv +-2
@@ -251,6 +252,7 @@ def _pallas_gru(hm: jax.Array, c1: jax.Array, c2: jax.Array, fw: dict,
 
 # ------------------------------------------------------------- XLA twin
 
+@stage("update/gru_xla_twin")
 @contract(h="*[B,H,W,C]", motion="*[B,H,W,M]", _returns="*[B,H,W,C]")
 def sep_conv_gru_xla(p: Dict[str, dict], h: jax.Array, motion: jax.Array,
                      ctx: Dict[str, jax.Array]) -> jax.Array:

@@ -43,6 +43,17 @@ def spatial_axis() -> Optional[str]:
     return _axis
 
 
+def axis_size(axis_name: str) -> int:
+    """Static size of a mapped axis, across jax versions: ``jax.lax
+    .axis_size`` where it exists (jax >= 0.5), else the classic
+    ``psum(1, axis)`` idiom — on a Python literal it constant-folds to the
+    axis size as a plain int, so callers can use it in static control
+    flow either way."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 def halo_exchange(x: jax.Array, halo: int, axis_name: Optional[str] = None) -> jax.Array:
     """Pad the H axis (axis 1 of [B, H, W, C]) of a row-sharded block with
     ``halo`` rows from the neighboring shards; zeros at the outer edges (the
@@ -51,7 +62,7 @@ def halo_exchange(x: jax.Array, halo: int, axis_name: Optional[str] = None) -> j
     if halo == 0:
         return x
     axis_name = _axis if axis_name is None else axis_name
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     Hl = x.shape[1]
     if halo > Hl:

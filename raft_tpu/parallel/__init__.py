@@ -1,6 +1,6 @@
 from .data_parallel import make_dp_eval_fn, make_dp_train_step
-from .mesh import (DATA_AXIS, SPATIAL_AXIS, batch_sharding, make_mesh,
-                   replicated, shard_batch)
+from .mesh import (DATA_AXIS, SPATIAL_AXIS, batch_sharding,
+                   compat_shard_map, make_mesh, replicated, shard_batch)
 from .spatial import (conv2d_row_sharded, halo_exchange,
                       make_ring_corr_lookup, make_ring_lookup_local,
                       make_shard_inference_fn, make_spatial_corr_lookup,

@@ -17,7 +17,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import RAFTConfig, TrainConfig
 from ..training.step import Batch, make_eval_step, make_train_step
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, compat_shard_map
 
 
 def make_dp_train_step(config: RAFTConfig, tconfig: TrainConfig, tx,
@@ -33,10 +33,9 @@ def make_dp_train_step(config: RAFTConfig, tconfig: TrainConfig, tx,
     in-flight copy of params+optimizer state."""
     inner = make_train_step(config, tconfig, tx, axis_name=axis)
     batch_spec = Batch(P(axis), P(axis), P(axis), P(axis))
-    f = jax.shard_map(inner, mesh=mesh,
+    f = compat_shard_map(inner, mesh=mesh,
                       in_specs=(P(), batch_spec, P()),
-                      out_specs=(P(), P()),
-                      check_vma=False)
+                      out_specs=(P(), P()))
     # donate the input state: the loop rebinds `state = step(state, ...)`,
     # so the old buffers are dead — donation lets XLA update in place
     return jax.jit(f, donate_argnums=0 if donate else ())
@@ -71,8 +70,7 @@ def make_dp_eval_fn(config: RAFTConfig, mesh: Mesh,
                     iters: Optional[int] = None, axis: str = DATA_AXIS):
     """Returns jitted (params, im1, im2) -> flow, batch sharded over ``axis``."""
     inner = make_eval_step(config, iters=iters)
-    f = jax.shard_map(inner, mesh=mesh,
+    f = compat_shard_map(inner, mesh=mesh,
                       in_specs=(P(), P(axis), P(axis)),
-                      out_specs=P(axis),
-                      check_vma=False)
+                      out_specs=P(axis))
     return jax.jit(f)

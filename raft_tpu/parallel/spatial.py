@@ -21,7 +21,7 @@ from ..config import RAFTConfig
 from ..ops import spmd as _spmd
 from ..ops.corr import (build_pyramid, dense_corr, fmap2_pyramid,
                         lookup_dense, lookup_partial_onehot)
-from .mesh import SPATIAL_AXIS
+from .mesh import SPATIAL_AXIS, compat_shard_map
 
 
 def required_h_multiple(config: RAFTConfig, n_devices: int) -> int:
@@ -68,10 +68,9 @@ def make_spatial_corr_lookup(mesh: Mesh, num_levels: int, radius: int,
         pyramid = build_pyramid(f1_local, f2_full, num_levels)
         return lookup_dense(pyramid, coords_local, radius)
 
-    f = jax.shard_map(inner, mesh=mesh,
+    f = compat_shard_map(inner, mesh=mesh,
                       in_specs=(P(None, axis), P(None, axis), P(None, axis)),
-                      out_specs=P(None, axis),
-                      check_vma=False)
+                      out_specs=P(None, axis))
     return jax.jit(f)
 
 
@@ -118,7 +117,7 @@ def make_ring_lookup_local(f1_local: jax.Array, f2_local: jax.Array,
         # branch's dense_corr applies
         pl_prec = (precision if precision is not None
                    else jax.lax.Precision.DEFAULT)
-    n_dev = jax.lax.axis_size(axis)
+    n_dev = _spmd.axis_size(axis)
     my = jax.lax.axis_index(axis)
     B, Hl, W, C = f1_local.shape
     if Hl % (2 ** (num_levels - 1)) != 0:
@@ -192,10 +191,9 @@ def make_ring_corr_lookup(mesh: Mesh, num_levels: int, radius: int,
                                         pallas_opts=pallas_opts)
         return lookup(coords_local)
 
-    f = jax.shard_map(inner, mesh=mesh,
+    f = compat_shard_map(inner, mesh=mesh,
                       in_specs=(P(None, axis), P(None, axis), P(None, axis)),
-                      out_specs=P(None, axis),
-                      check_vma=False)
+                      out_specs=P(None, axis))
     return jax.jit(f)
 
 
@@ -225,10 +223,9 @@ def make_shard_inference_fn(config: RAFTConfig, mesh: Mesh,
                                   iters=iters, train=False, all_flows=False)
         return out.flow
 
-    f = jax.shard_map(fwd, mesh=mesh,
+    f = compat_shard_map(fwd, mesh=mesh,
                       in_specs=(P(), P(None, axis), P(None, axis)),
-                      out_specs=P(None, axis),
-                      check_vma=False)
+                      out_specs=P(None, axis))
     return jax.jit(f)
 
 

@@ -23,7 +23,10 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from ..config import RAFTConfig
+from ..telemetry.log import get_logger
 from .config import ServeConfig
+
+_log = get_logger("serve")
 
 
 class InferenceEngine:
@@ -99,8 +102,8 @@ class InferenceEngine:
                     self._exec.setdefault(key, ex)
                 n += 1
                 if verbose:
-                    print(f"[serve] warmed bucket {h}x{w} batch {b} "
-                          f"({time.monotonic() - t0:.1f}s elapsed)")
+                    _log.info(f"warmed bucket {h}x{w} batch {b} "
+                              f"({time.monotonic() - t0:.1f}s elapsed)")
         self.warmup_seconds = time.monotonic() - t0
         return n
 

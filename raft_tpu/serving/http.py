@@ -33,7 +33,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..telemetry.log import get_logger
 from .queue import RejectedError
+
+_log = get_logger("serve")
 
 MAX_BODY_BYTES = 256 * 2**20   # one 4K pair is ~100 MB as float32 JSON
 
@@ -108,7 +111,7 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):   # route through the app, not stderr
         app = self.server_app
         if app is not None and app.verbose:
-            print(f"[serve] {self.address_string()} {fmt % args}")
+            _log.info(f"{self.address_string()} {fmt % args}")
 
     def _send(self, status: int, body: bytes, content_type: str) -> None:
         self.send_response(status)

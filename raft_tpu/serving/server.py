@@ -26,6 +26,10 @@ import numpy as np
 
 from ..config import RAFTConfig
 from ..data.pipeline import pad_to_shape
+from ..telemetry import events as tlm_events
+from ..telemetry import watchdogs as tlm_watchdogs
+from ..telemetry.log import get_logger
+from ..telemetry.trace import TraceWindow, stage
 from .batcher import MicroBatcher
 from .config import ServeConfig
 from .engine import InferenceEngine
@@ -33,13 +37,22 @@ from .http import BadRequest, make_http_server, serve_in_thread
 from .metrics import Registry, make_serving_metrics
 from .queue import DeadlineExceeded, Draining, Request, RequestQueue
 
+_log = get_logger("serve")
+
 
 class FlowServer:
     def __init__(self, config: RAFTConfig, params, sconfig: ServeConfig,
                  iters: Optional[int] = None, engine=None,
-                 verbose: bool = False):
+                 verbose: bool = False,
+                 trace_dir: Optional[str] = None, trace_steps: int = 4):
         self.sconfig = sconfig
         self.verbose = verbose
+        # --trace generalized to serving: capture device batches 1..1+N
+        # (batch 0 may pay a cold compile under --no-warmup)
+        self._trace_window = TraceWindow(trace_dir, first=1,
+                                         steps=trace_steps, log_fn=_log.info)
+        self._device_batches = 0
+        self._recompile_watch = None
         self.registry = Registry()
         self.queue = RequestQueue(sconfig.queue_depth)
         self.metrics = make_serving_metrics(
@@ -63,8 +76,11 @@ class FlowServer:
     #    stub engine still produces hit/miss metrics when it exposes them) -
 
     def _run_engine(self, bucket, im1, im2):
+        self._trace_window.on_step(self._device_batches)
+        self._device_batches += 1
         before = getattr(self.engine, "compile_misses", None)
-        out = self.engine.run(bucket, im1, im2)
+        with stage("serve/batch"):
+            out = self.engine.run(bucket, im1, im2)
         if before is not None:
             after = self.engine.compile_misses
             if after > before:
@@ -87,11 +103,26 @@ class FlowServer:
             self.registry.gauge("raft_serving_compile_cache_entries",
                                 "Warm executables resident",
                                 fn=self.engine_executables)
+        if tlm_watchdogs.watchdogs_enabled():
+            # stack-wide XLA compile listener (the serving engine's own
+            # hit/miss counters see only its executables; this one also
+            # catches strays — e.g. a tool jitting in-process) + live HBM
+            # gauges.  Registered only when watchdogs are on, so the
+            # default /metrics exposition stays byte-identical.
+            self._recompile_watch = tlm_watchdogs.RecompileWatch(
+                counter=self.registry.counter(
+                    "raft_serving_xla_recompiles_total",
+                    "XLA compiles observed after warmup (watchdog)"),
+                run_log=tlm_events.current(),
+                log_fn=_log.warning).install()
+            tlm_watchdogs.hbm_gauges(self.registry, prefix="raft_serving")
         if self.sconfig.warmup and hasattr(self.engine, "warmup"):
             n = self.engine.warmup(verbose=self.verbose)
             if self.verbose:
-                print(f"[serve] warmup compiled {n} executable(s) in "
-                      f"{self.engine.warmup_seconds:.1f}s")
+                _log.info(f"warmup compiled {n} executable(s) in "
+                          f"{self.engine.warmup_seconds:.1f}s")
+        if self._recompile_watch is not None:
+            self._recompile_watch.arm()
         self.batcher.start()
         self._httpd = make_http_server(self, self.sconfig.host,
                                        self.sconfig.port)
@@ -120,6 +151,9 @@ class FlowServer:
                 r.fail(Draining("server shut down before this request ran"))
         self.queue.close()            # batcher drains the rest, then exits
         self.batcher.join(timeout)
+        self._trace_window.stop()
+        if self._recompile_watch is not None:
+            self._recompile_watch.remove()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -195,7 +229,9 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
         return 2
     params = load_params(args, config)
     server = FlowServer(config, params, sconfig, iters=args.iters,
-                        verbose=True)
+                        verbose=True,
+                        trace_dir=getattr(args, "trace", None),
+                        trace_steps=getattr(args, "trace_steps", None) or 4)
     t0 = time.monotonic()
     server.start()
     print(f"[serve] listening on {server.url}  "

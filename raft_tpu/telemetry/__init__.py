@@ -1,0 +1,29 @@
+"""raft_tpu.telemetry — the one observability spine (OBSERVABILITY.md).
+
+Four small, dependency-free layers shared by train, serve, and bench:
+
+* :mod:`registry` — Counter / Gauge / Histogram metric primitives + the
+  Prometheus-text Registry (promoted out of ``serving/metrics.py``; the
+  serving stack keeps a compat shim).
+* :mod:`events` — run manifests (git sha, jax versions, device, config
+  hash, argv) and the structured JSONL run-event log every CLI mode emits;
+  ``tools/tlm.py`` tails / summarizes / diffs them.
+* :mod:`trace` — ``stage(name)`` named-scope annotations threaded through
+  the model so xprof traces carry per-stage names, plus the
+  ``TraceWindow`` step-window profiler capture generalized from the train
+  loop to val / bench / serve.
+* :mod:`watchdogs` — opt-in recompile counter (stack-wide twin of the
+  serving engine's hit/miss accounting), implicit-transfer guard, HBM
+  gauges, and the NaN/Inf sentinel with stage provenance.
+
+``registry`` and ``events`` import no jax at module level (the linter and
+the manifest tooling must run without it); ``trace`` / ``watchdogs``
+import jax lazily inside the functions that need it.
+"""
+
+from .registry import (Counter, Gauge, Histogram,  # noqa: F401
+                       Registry, DEFAULT_LATENCY_BUCKETS, default_registry)
+from .events import (RunLog, config_hash, read_events,  # noqa: F401
+                     run_manifest, start_run)
+from .log import get_logger  # noqa: F401
+from .trace import TraceWindow, current_stage, stage  # noqa: F401

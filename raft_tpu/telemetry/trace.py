@@ -1,0 +1,100 @@
+"""Named-stage tracing: ``stage()`` scopes + the step-window profiler.
+
+RAFT's forward pass is ~10 structurally identical GRU iterations — without
+names, an xprof trace is a wall of indistinguishable fusions and nobody can
+say *which* stage regressed or recompiled.  ``stage(name)`` wraps
+``jax.named_scope`` so the op names XLA emits (and tools/profile_breakdown
+reports) carry ``raft/fnet``, ``raft/corr_lookup``, ``update/gru`` …
+prefixes; it also maintains a thread-local stage stack that
+:mod:`watchdogs` reads to attribute recompiles and NaN events to the stage
+that produced them.
+
+``TraceWindow`` generalizes the train loop's steps-5-to-8 profiler capture
+to any per-step loop (val batches, bench reps, serve device batches):
+construct with a trace dir + window, call ``on_step(i)`` once per step, and
+the jax.profiler trace starts/stops itself; ``stop()`` in a finally block
+covers early exits.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+_stack = threading.local()
+
+
+def _stages() -> list:
+    if not hasattr(_stack, "names"):
+        _stack.names = []
+    return _stack.names
+
+
+def current_stage() -> Optional[str]:
+    """Innermost active ``stage()`` name on this thread (provenance for the
+    watchdogs), or None outside any stage."""
+    names = _stages()
+    return names[-1] if names else None
+
+
+@contextlib.contextmanager
+def stage(name: str):
+    """``jax.named_scope(name)`` + provenance bookkeeping.
+
+    Usable both as a context manager around trace-time code and (because
+    named_scope supports it) as a decorator.  Zero-dependency fallback:
+    when jax is unimportable the scope is a no-op but the provenance stack
+    still works, so host-side tooling can reuse it.
+    """
+    names = _stages()
+    names.append(name)
+    try:
+        try:
+            import jax
+            scope = jax.named_scope(name)
+        except ImportError:
+            scope = contextlib.nullcontext()
+        with scope:
+            yield
+    finally:
+        names.pop()
+
+
+class TraceWindow:
+    """Start/stop a jax.profiler trace over a step window.
+
+    ``TraceWindow(dir, first, steps)`` traces steps ``[first, first+steps)``
+    — call ``on_step(i)`` before executing step ``i``; returns True while
+    tracing.  A ``trace_dir`` of None makes every call a no-op, so call
+    sites need no conditionals.  ``stop()`` is idempotent and must run on
+    every exit path (the profiler otherwise holds its buffer forever).
+    """
+
+    def __init__(self, trace_dir: Optional[str], first: int = 2,
+                 steps: int = 4, log_fn=None):
+        self.trace_dir = trace_dir
+        self.first = first
+        self.last = first + steps          # exclusive
+        self._tracing = False
+        self._done = trace_dir is None
+        self._log = log_fn or (lambda msg: None)
+
+    def on_step(self, step: int) -> bool:
+        if self._done:
+            return False
+        if not self._tracing and self.first <= step < self.last:
+            import jax
+            jax.profiler.start_trace(self.trace_dir)
+            self._tracing = True
+        elif self._tracing and step >= self.last:
+            self.stop()
+        return self._tracing
+
+    def stop(self) -> None:
+        if self._tracing:
+            import jax
+            jax.profiler.stop_trace()
+            self._tracing = False
+            self._done = True
+            self._log(f"wrote profiler trace to {self.trace_dir}")

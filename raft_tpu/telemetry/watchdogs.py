@@ -1,0 +1,228 @@
+"""Opt-in runtime watchdogs: recompiles, implicit transfers, HBM, NaN/Inf.
+
+Four failure modes that silently eat TPU throughput or corrupt runs, each
+surfaced with **stage provenance** (the innermost :func:`trace.stage` name
+active when the event fired):
+
+* **RecompileWatch** — counts XLA backend compiles via ``jax.monitoring``
+  (the stack-wide generalization of the serving engine's per-executable
+  hit/miss counters).  ``arm()`` after warmup; any compile after that is a
+  recompile storm in the making and is recorded with its stage.
+* **transfer_watch** — ``jax.transfer_guard`` context: implicit
+  device<->host transfers (the classic hidden sync) log or raise.
+* **hbm_gauges** — ``device.memory_stats()`` bytes in use / limit as live
+  registry gauges (None-safe: CPU backends report no stats).
+* **NaN sentinel** — ``nan_guard(x, stage)`` inserts a ``jax.debug``
+  callback that records the first non-finite tensor *inside* the compiled
+  step, with the stage that produced it — hours earlier than the loss
+  going NaN at the next logged step.
+
+Everything is opt-in (``install``/``enable`` calls or the
+``RAFT_TPU_WATCHDOGS=1`` env var) and free when off: ``nan_guard`` returns
+its input untouched unless the sentinel is enabled at trace time.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional
+
+from .log import get_logger
+from .trace import current_stage
+
+_log = get_logger("watchdog")
+
+# jax.monitoring event key observed on every XLA backend compile
+# (jax 0.4.x: fires for jit, AOT .compile(), and pallas alike)
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def watchdogs_enabled() -> bool:
+    return os.environ.get("RAFT_TPU_WATCHDOGS", "") not in ("", "0", "false")
+
+
+# --------------------------------------------------------------- recompiles
+
+class RecompileWatch:
+    """Stack-wide compile counter with arm/disarm semantics.
+
+    ``install()`` registers ONE process-wide jax.monitoring listener (the
+    API has no unregister, so instances share it); each watch keeps its own
+    counts.  ``arm()`` marks warmup complete: compiles before it are
+    expected (and counted separately), compiles after it are *recompiles*
+    and recorded with stage provenance + an optional registry counter /
+    run-log event.
+    """
+
+    _instances: List["RecompileWatch"] = []
+    _listener_installed = False
+    _lock = threading.Lock()
+
+    def __init__(self, counter=None, run_log=None, log_fn=None):
+        self.compiles = 0                  # total since construction
+        self.warmup_compiles = 0
+        self.recompiles = 0                # compiles after arm()
+        self.events: List[dict] = []       # recompile records w/ stage
+        self.armed = False
+        self._counter = counter            # telemetry.registry.Counter
+        self._run_log = run_log            # telemetry.events.RunLog
+        self._log_fn = log_fn
+
+    def install(self) -> "RecompileWatch":
+        with RecompileWatch._lock:
+            RecompileWatch._instances.append(self)
+            if not RecompileWatch._listener_installed:
+                import jax
+                jax.monitoring.register_event_duration_secs_listener(
+                    RecompileWatch._on_event)
+                RecompileWatch._listener_installed = True
+        return self
+
+    def remove(self) -> None:
+        with RecompileWatch._lock:
+            if self in RecompileWatch._instances:
+                RecompileWatch._instances.remove(self)
+
+    def arm(self) -> None:
+        """Warmup is over: every compile from here on is a recompile."""
+        self.armed = True
+
+    @staticmethod
+    def _on_event(event: str, duration: float, **kwargs) -> None:
+        if event != _COMPILE_EVENT:
+            return
+        with RecompileWatch._lock:
+            watches = list(RecompileWatch._instances)
+        for w in watches:
+            w._record(duration)
+
+    def _record(self, duration: float) -> None:
+        self.compiles += 1
+        if not self.armed:
+            self.warmup_compiles += 1
+            return
+        stage = current_stage()
+        self.recompiles += 1
+        rec = {"stage": stage, "duration_s": round(duration, 4),
+               "n": self.recompiles}
+        self.events.append(rec)
+        if self._counter is not None:
+            self._counter.inc()
+        if self._run_log is not None:
+            self._run_log.event("recompile", **rec)
+        msg = (f"recompile #{self.recompiles} after warmup "
+               f"(stage={stage or '<unknown>'}, "
+               f"{duration:.2f}s of XLA time)")
+        if self._log_fn is not None:
+            self._log_fn(msg)
+        else:
+            _log.warning(msg)
+
+
+# ------------------------------------------------------ implicit transfers
+
+def transfer_watch(level: str = "log"):
+    """Context manager flagging implicit device<->host transfers.
+
+    ``level``: 'log' (warn and continue) or 'disallow' (raise at the exact
+    offending line).  Explicit transfers — ``jax.device_get``,
+    ``jax.device_put``, ``np.asarray(..)`` on a committed array — stay
+    allowed ('*_explicit'); the guard catches the silent ones a profiler
+    only shows as mysterious gaps.
+    """
+    if level not in ("log", "disallow"):
+        raise ValueError(f"transfer_watch level must be 'log' or "
+                         f"'disallow', got {level!r}")
+    import jax
+    return jax.transfer_guard(level)
+
+
+# ----------------------------------------------------------------- HBM use
+
+def hbm_gauges(registry, prefix: str = "raft") -> dict:
+    """Live device-memory gauges sampled at render/snapshot time.
+
+    ``device.memory_stats()`` returns None on backends without the stats
+    API (CPU) — the gauges then read 0 rather than failing, so the same
+    wiring runs in tests and on hardware.
+    """
+    def _stat(key: str):
+        def read():
+            try:
+                import jax
+                stats = jax.local_devices()[0].memory_stats()
+            except Exception:  # noqa: BLE001 — backend down / no stats
+                return 0
+            return (stats or {}).get(key, 0)
+        return read
+
+    return {
+        "bytes_in_use": registry.gauge(
+            f"{prefix}_hbm_bytes_in_use",
+            "Device memory currently allocated (device 0)",
+            fn=_stat("bytes_in_use")),
+        "bytes_limit": registry.gauge(
+            f"{prefix}_hbm_bytes_limit",
+            "Device memory capacity (device 0)",
+            fn=_stat("bytes_limit")),
+    }
+
+
+# ----------------------------------------------------------- NaN sentinel
+
+_nan_enabled = False
+_nan_events: List[dict] = []
+_nan_run_log = None
+
+
+def enable_nan_sentinel(on: bool = True, run_log=None) -> None:
+    """Turn the in-graph NaN/Inf sentinel on (trace-time switch: functions
+    compiled while it is off contain no callback and pay nothing)."""
+    global _nan_enabled, _nan_run_log
+    _nan_enabled = on
+    _nan_run_log = run_log
+    if on:
+        _nan_events.clear()
+
+
+def nan_sentinel_enabled() -> bool:
+    return _nan_enabled or watchdogs_enabled()
+
+
+def nan_events() -> List[dict]:
+    """Records appended by the sentinel callback, oldest first."""
+    return _nan_events
+
+
+def _report_nonfinite(bad_count, stage: str) -> None:
+    n = int(bad_count)
+    if n == 0:
+        return
+    rec = {"stage": stage, "bad_values": n}
+    _nan_events.append(rec)
+    if _nan_run_log is not None:
+        _nan_run_log.event("nonfinite", **rec)
+    _log.warning(f"non-finite values: {n} element(s) in stage "
+                 f"{stage!r}")
+
+
+def nan_guard(x, name: Optional[str] = None):
+    """Identity on ``x``; when the sentinel is enabled at trace time, also
+    emits a host callback recording any non-finite elements with stage
+    provenance (``name`` or the innermost active ``stage()``).
+
+    The callback rides ``jax.debug.callback`` so it survives jit / scan /
+    remat; it adds one ``isfinite`` reduction per guarded tensor — why the
+    sentinel is opt-in rather than always-on.
+    """
+    if not nan_sentinel_enabled():
+        return x
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    stage = name or current_stage() or "<unstaged>"
+    bad = jnp.size(x) - jnp.isfinite(x).sum()
+    jax.debug.callback(functools.partial(_report_nonfinite, stage=stage), bad)
+    return x

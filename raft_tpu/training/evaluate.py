@@ -26,8 +26,12 @@ import numpy as np
 
 from ..config import RAFTConfig
 from ..data.pipeline import pad_to_multiple, unpad
+from ..telemetry.log import get_logger
+from ..telemetry.trace import TraceWindow, stage
 from .loss import epe_metrics
 from .step import make_eval_step
+
+_log = get_logger("val")
 
 
 @functools.lru_cache(maxsize=8)
@@ -63,6 +67,7 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
                      weighting: str = "sample", batch_size: int = 1,
                      dump_dir: Optional[str] = None,
                      warm_start: bool = False,
+                     trace_dir: Optional[str] = None, trace_steps: int = 4,
                      verbose: bool = True) -> Dict[str, float]:
     """dataset yields (im1, im2, flow_gt, valid) numpy samples (augmentor=None).
 
@@ -100,6 +105,10 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
     (utils.frame_utils.forward_interpolate) and seeds the next frame's
     recurrence; scene boundaries (``dataset.is_scene_start``) reset to a
     cold start.  Sequential, so requires ``batch_size == 1``.
+
+    ``trace_dir``/``trace_steps``: capture a jax.profiler trace of device
+    calls 1..1+trace_steps (the first call pays the compile and is skipped)
+    — the train loop's trace window generalized to eval (OBSERVABILITY.md).
     """
     assert bucket % 8 == 0 and bucket > 0, bucket
     assert batch_size >= 1, batch_size
@@ -133,9 +142,9 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
             # this run only overwrites the indices it visits — a shorter or
             # reordered run would leave a previous checkpoint's predictions
             # interleaved with no way to tell them apart
-            print(f"  WARNING: --dump-flow dir {dump_dir} already holds "
-                  f"{stale} file(s); stale predictions from a previous run "
-                  f"will remain unless overwritten")
+            _log.warning(f"--dump-flow dir {dump_dir} already holds "
+                         f"{stale} file(s); stale predictions from a "
+                         f"previous run will remain unless overwritten")
 
     def account(flows_dev, group):
         """Metrics + dump + progress for already-computed (padded) flows."""
@@ -178,88 +187,106 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
         if verbose and has_gt and count // 50 > prev // 50:
             running = (sums["epe"] / max(sums.get("valid_px", 1.0), 1.0)
                        if weighting == "pixel" else sums["epe"] / count)
-            print(f"  eval {count}/{n}  epe so far {running:.3f}")
+            _log.info(f"eval {count}/{n}  epe so far {running:.3f}")
+
+    # first device call compiles; the window starts at call 1 so the trace
+    # captures steady-state execution, not the XLA compile
+    trace_window = TraceWindow(trace_dir, first=1, steps=trace_steps,
+                               log_fn=_log.info if verbose else None)
+    flushes = 0
 
     def flush(group):
         # record the executable's ACTUAL input shape (batch included): with
         # batching, a shape group costs one compile per distinct flush size
         # (full batches + at most one remainder)
+        nonlocal flushes
         shapes_seen.add((len(group),) + group[0][0].shape[1:])
-        flows_dev = eval_fn(
-            params, jnp.asarray(np.concatenate([g[0] for g in group])),
-            jnp.asarray(np.concatenate([g[1] for g in group])))
+        trace_window.on_step(flushes)
+        flushes += 1
+        with stage("val/forward"):
+            flows_dev = eval_fn(
+                params, jnp.asarray(np.concatenate([g[0] for g in group])),
+                jnp.asarray(np.concatenate([g[1] for g in group])))
         account(flows_dev, group)
 
-    if warm_start:
-        # Official Sintel warm-start protocol: within a scene, frame t's
-        # low-res flow — forward-projected along itself — seeds frame t+1;
-        # scene boundaries reset to a cold (zeros) start.  Sequential by
-        # construction, so batching is rejected rather than silently
-        # reordered.
-        from ..utils.frame_utils import forward_interpolate
-        if batch_size != 1:
-            raise ValueError("warm_start evaluation is sequential (frame t "
-                             "seeds frame t+1): use --eval-batch 1")
-        if not hasattr(dataset, "is_scene_start"):
-            raise ValueError(
-                "warm_start needs a dataset with scene structure "
-                "(is_scene_start), e.g. MpiSintel")
-        warm_fn = _jitted_eval_fn(config, iters, warm=True)
+    try:
+        if warm_start:
+            # Official Sintel warm-start protocol: within a scene, frame t's
+            # low-res flow — forward-projected along itself — seeds frame
+            # t+1; scene boundaries reset to a cold (zeros) start.
+            # Sequential by construction, so batching is rejected rather
+            # than silently reordered.
+            from ..utils.frame_utils import forward_interpolate
+            if batch_size != 1:
+                raise ValueError("warm_start evaluation is sequential "
+                                 "(frame t seeds frame t+1): use "
+                                 "--eval-batch 1")
+            if not hasattr(dataset, "is_scene_start"):
+                raise ValueError(
+                    "warm_start needs a dataset with scene structure "
+                    "(is_scene_start), e.g. MpiSintel")
+            warm_fn = _jitted_eval_fn(config, iters, warm=True)
 
-        # The seed dependency (frame t's DEVICE output feeds frame t+1's
-        # host-side forward_interpolate) makes the compute chain strictly
-        # sequential — but frame t+1's image decode + padding is pure host
-        # IO with no dependency on t, so a one-step lookahead thread
-        # overlaps it with the device call for frame t.
-        from concurrent.futures import ThreadPoolExecutor
+            # The seed dependency (frame t's DEVICE output feeds frame t+1's
+            # host-side forward_interpolate) makes the compute chain strictly
+            # sequential — but frame t+1's image decode + padding is pure
+            # host IO with no dependency on t, so a one-step lookahead
+            # thread overlaps it with the device call for frame t.
+            from concurrent.futures import ThreadPoolExecutor
 
-        def _load(idx):
-            im1, im2, flow_gt, valid = dataset[idx]
-            im1p, pads = pad_to_multiple(im1[None], bucket, pad_mode)
-            im2p, _ = pad_to_multiple(im2[None], bucket, pad_mode)
-            return im1p, im2p, pads, flow_gt, valid
+            def _load(idx):
+                im1, im2, flow_gt, valid = dataset[idx]
+                im1p, pads = pad_to_multiple(im1[None], bucket, pad_mode)
+                im2p, _ = pad_to_multiple(im2[None], bucket, pad_mode)
+                return im1p, im2p, pads, flow_gt, valid
 
-        prev_lr = None
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            fut = pool.submit(_load, 0) if n else None
-            try:
-                for idx in range(n):
-                    im1p, im2p, pads, flow_gt, valid = fut.result()
-                    if idx + 1 < n:
-                        fut = pool.submit(_load, idx + 1)
-                    shapes_seen.add((1,) + im1p.shape[1:])
-                    h8, w8 = im1p.shape[1] // 8, im1p.shape[2] // 8
-                    if (dataset.is_scene_start(idx) or prev_lr is None
-                            or prev_lr.shape[1:3] != (h8, w8)):
-                        init = np.zeros((1, h8, w8, 2), np.float32)
-                    else:
-                        init = forward_interpolate(prev_lr[0])[None]
-                    flow_dev, lr_dev = warm_fn(params, jnp.asarray(im1p),
-                                               jnp.asarray(im2p),
-                                               jnp.asarray(init))
-                    prev_lr = np.asarray(lr_dev)
-                    account(flow_dev,
-                            [(im1p, im2p, pads, flow_gt, valid, idx)])
-            finally:
-                # if warm_fn/account raised mid-loop, don't let the pending
-                # lookahead _load run to completion (and have its own
-                # exception swallowed) during executor shutdown (ADVICE r5)
-                if fut is not None:
-                    fut.cancel()
-    else:
-        groups: Dict[tuple, list] = {}
-        for idx in range(n):
-            im1, im2, flow_gt, valid = dataset[idx]
-            im1p, pads = pad_to_multiple(im1[None], bucket, pad_mode)
-            im2p, _ = pad_to_multiple(im2[None], bucket, pad_mode)
-            group = groups.setdefault(im1p.shape, [])
-            group.append((im1p, im2p, pads, flow_gt, valid, idx))
-            if len(group) == batch_size:
-                flush(group)
-                group.clear()
-        for group in groups.values():   # shape-group remainders
-            if group:
-                flush(group)
+            prev_lr = None
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                fut = pool.submit(_load, 0) if n else None
+                try:
+                    for idx in range(n):
+                        im1p, im2p, pads, flow_gt, valid = fut.result()
+                        if idx + 1 < n:
+                            fut = pool.submit(_load, idx + 1)
+                        shapes_seen.add((1,) + im1p.shape[1:])
+                        trace_window.on_step(idx)
+                        h8, w8 = im1p.shape[1] // 8, im1p.shape[2] // 8
+                        if (dataset.is_scene_start(idx) or prev_lr is None
+                                or prev_lr.shape[1:3] != (h8, w8)):
+                            init = np.zeros((1, h8, w8, 2), np.float32)
+                        else:
+                            init = forward_interpolate(prev_lr[0])[None]
+                        with stage("val/forward"):
+                            flow_dev, lr_dev = warm_fn(params,
+                                                       jnp.asarray(im1p),
+                                                       jnp.asarray(im2p),
+                                                       jnp.asarray(init))
+                        prev_lr = np.asarray(lr_dev)
+                        account(flow_dev,
+                                [(im1p, im2p, pads, flow_gt, valid, idx)])
+                finally:
+                    # if warm_fn/account raised mid-loop, don't let the
+                    # pending lookahead _load run to completion (and have
+                    # its own exception swallowed) during executor shutdown
+                    # (ADVICE r5)
+                    if fut is not None:
+                        fut.cancel()
+        else:
+            groups: Dict[tuple, list] = {}
+            for idx in range(n):
+                im1, im2, flow_gt, valid = dataset[idx]
+                im1p, pads = pad_to_multiple(im1[None], bucket, pad_mode)
+                im2p, _ = pad_to_multiple(im2[None], bucket, pad_mode)
+                group = groups.setdefault(im1p.shape, [])
+                group.append((im1p, im2p, pads, flow_gt, valid, idx))
+                if len(group) == batch_size:
+                    flush(group)
+                    group.clear()
+            for group in groups.values():   # shape-group remainders
+                if group:
+                    flush(group)
+    finally:
+        trace_window.stop()     # every exit path releases the profiler
     if weighting == "pixel":
         denom = max(sums.pop("valid_px", 0.0), 1.0)
         out = {k: v / denom for k, v in sums.items()}
@@ -377,6 +404,9 @@ def evaluate_cli(args, config: RAFTConfig, load_params) -> int:
                                batch_size=getattr(args, "eval_batch", None) or 1,
                                dump_dir=getattr(args, "dump_flow", None),
                                warm_start=getattr(args, "warm_start", False),
+                               trace_dir=getattr(args, "trace", None),
+                               trace_steps=getattr(args, "trace_steps", None)
+                               or 4,
                                max_samples=getattr(args, "max_samples", None))
     name = f"{args.dataset} ({'small' if args.small else 'full'})"
     if not getattr(ds, "has_gt", True):

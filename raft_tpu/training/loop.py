@@ -19,6 +19,10 @@ import numpy as np
 
 from ..config import RAFTConfig, TrainConfig, init_rng
 from ..models import init_raft
+from ..telemetry import Registry, config_hash, run_manifest
+from ..telemetry import events as tlm_events
+from ..telemetry import watchdogs as tlm_watchdogs
+from ..telemetry.trace import TraceWindow, stage
 from .checkpoint import (latest_checkpoint, restore_checkpoint_compat,
                          save_checkpoint)
 from .optim import make_optimizer
@@ -29,7 +33,7 @@ from .step import Batch, make_train_step
 def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
           ckpt_dir: Optional[str] = None, resume: bool = True,
           data_parallel: bool = True, log_fn=print,
-          trace_dir: Optional[str] = None,
+          trace_dir: Optional[str] = None, trace_steps: int = 4,
           init_params: Optional[dict] = None) -> TrainState:
     """Run the training loop over ``batch_iter`` yielding numpy
     (im1, im2, flow, valid) batches; returns the final state.
@@ -137,12 +141,38 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
         state = jax.tree.map(
             lambda x: mh_assemble(x, jax.sharding.PartitionSpec()), state)
 
-    # profiler window: steps 5-8 inclusive relative to start (post-compile,
-    # steady state; stop fires when step reaches the exclusive end) — the
-    # jax.profiler replacement for the reference's tf.profiler
-    # (reference infer_raft.py:88-92, which crashed before printing)
-    trace_window = (start_step + 5, start_step + 9) if trace_dir else None
-    tracing = False
+    # profiler window: steps 5..5+trace_steps relative to start (post-compile,
+    # steady state) — telemetry.trace.TraceWindow, the generalization of the
+    # old hand-rolled steps-5-to-8 capture (and the jax.profiler replacement
+    # for the reference's tf.profiler, reference infer_raft.py:88-92, which
+    # crashed before printing).  Short runs (CI smoke) start the window at
+    # step 0 so a 2-step run still produces a trace.
+    first = start_step + (5 if tconfig.num_steps - start_step
+                          >= 5 + trace_steps else 0)
+    trace_window = TraceWindow(trace_dir, first=first, steps=trace_steps,
+                               log_fn=lambda m: log_fn(f"[train] {m}"))
+
+    # shared telemetry registry (OBSERVABILITY.md): the same Counter/Gauge
+    # primitives the serving stack scrapes, snapshotted into metrics.jsonl
+    # at the end of the run so `tlm compare` can diff two training runs
+    registry = Registry()
+    m_steps = registry.counter("raft_train_steps_total",
+                               "Optimizer steps executed this session")
+    m_nonfinite = registry.counter("raft_train_nonfinite_total",
+                                   "Logged steps with non-finite loss")
+    m_ckpts = registry.counter("raft_train_checkpoints_total",
+                               "Checkpoints written this session")
+    m_rate = registry.gauge("raft_train_steps_per_sec",
+                            "Steady-state training throughput")
+
+    # opt-in watchdogs (RAFT_TPU_WATCHDOGS=1 / --watchdogs): any XLA compile
+    # after the first step is a recompile storm in the making — recorded
+    # with stage provenance into the active run log
+    recompile_watch = None
+    if tlm_watchdogs.watchdogs_enabled():
+        recompile_watch = tlm_watchdogs.RecompileWatch(
+            run_log=tlm_events.current(),
+            log_fn=lambda m: log_fn(f"[train] {m}")).install()
 
     # scalar metrics stream: one JSON object per logged step, appended to
     # <ckpt_dir>/metrics.jsonl (the durable-observability replacement for
@@ -163,15 +193,39 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
 
             def _keep(ln: str) -> bool:
                 try:
-                    return json.loads(ln).get("step", -1) < start_step
+                    rec = json.loads(ln)
                 except json.JSONDecodeError:
                     return False   # partial line from the crash mid-append
+                if start_step == 0:
+                    # fresh run in a reused dir: nothing from the dead run
+                    # survives — step records, its manifest, its run_end
+                    return False
+                if rec.get("event") == "manifest":
+                    # a session that resumed at < start_step produced kept
+                    # step records; a dead session at >= start_step did not
+                    return rec.get("start_step", 0) < start_step
+                if rec.get("event") == "run_end":
+                    # its session ended at/before the resume point -> keep
+                    return rec.get("final_step", 1 << 62) <= start_step
+                if "event" in rec:
+                    return False   # unattributable event from the dead run
+                return rec.get("step", -1) < start_step
 
             kept = [ln for ln in lines if _keep(ln)]
             if len(kept) != len(lines):
                 metrics_path.write_text("".join(ln + "\n" for ln in kept))
                 log_fn(f"[train] metrics.jsonl: dropped {len(lines) - len(kept)} "
                        f"record(s) from steps >= {start_step} (replayed)")
+        # provenance: every session stamps its manifest (git sha, jax
+        # versions, device kind, config hash) before the first step record —
+        # append-only, so a resumed run carries one manifest per session and
+        # `tlm` attributes every segment to its exact commit + config
+        manifest = run_manifest(config=config, mode="train",
+                                extra={"tconfig_hash": config_hash(tconfig),
+                                       "start_step": start_step})
+        with open(metrics_path, "a") as f:
+            f.write(json.dumps({"event": "manifest", **manifest},
+                               default=str) + "\n")
 
     rng = jax.random.PRNGKey(tconfig.seed + 1)
     t0 = time.time()
@@ -181,13 +235,7 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
         step = int(state.step)
         if step >= tconfig.num_steps:
             break
-        if trace_window and not tracing and step == trace_window[0]:
-            jax.profiler.start_trace(trace_dir)
-            tracing = True
-        if tracing and step >= trace_window[1]:
-            jax.profiler.stop_trace()
-            tracing = False
-            log_fn(f"[train] wrote profiler trace to {trace_dir}")
+        trace_window.on_step(step)
         rng, sub = jax.random.split(rng)
         if multihost:
             # each process feeds its local slice; the arrays are global,
@@ -197,11 +245,19 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
             sub = mh_assemble(sub, jax.sharding.PartitionSpec())
         else:
             batch = Batch(*jax.tree.map(jnp.asarray, tuple(batch_np)))
-        state, metrics = step_fn(state, batch, sub)
+        # host-side stage scope: an XLA compile fired from inside this call
+        # (the recompile watchdog's listener) is attributed to 'train/step'
+        with stage("train/step"):
+            state, metrics = step_fn(state, batch, sub)
         seen += 1
+        m_steps.inc()
+        if recompile_watch is not None and seen == 1:
+            # the first step's compile is expected; everything after is not
+            recompile_watch.arm()
         if step % tconfig.log_every == 0 or step + 1 >= tconfig.num_steps:
             m = jax.device_get(metrics)
             rate = seen / max(time.time() - t0, 1e-9)
+            m_rate.set(rate)
             log_fn(f"[train] step {step}  loss {float(m['loss']):.4f}  "
                    f"epe {float(m['epe']):.3f}  1px {float(m['1px']):.3f}  "
                    f"gnorm {float(m['grad_norm']):.2f}  {rate:.2f} it/s")
@@ -217,32 +273,47 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
             # and should stop rather than burn the remaining budget
             if not np.isfinite(float(m["loss"])):
                 nonfinite_streak += 1
+                m_nonfinite.inc()
             else:
                 nonfinite_streak = 0
             if tconfig.halt_on_nonfinite and nonfinite_streak >= 3:
-                if tracing:
-                    jax.profiler.stop_trace()
+                trace_window.stop()
                 raise FloatingPointError(
                     f"non-finite loss at {nonfinite_streak} consecutive "
                     f"logged steps (last: step {step}); last good checkpoint "
                     f"is in {ckpt_dir or '<none>'}")
         if ckpt_dir and is_main and (step + 1) % tconfig.ckpt_every == 0:
-            _save_if_finite(Path(ckpt_dir) / f"ckpt_{step + 1}.npz",
-                            state, log_fn)
+            if _save_if_finite(Path(ckpt_dir) / f"ckpt_{step + 1}.npz",
+                               state, log_fn):
+                m_ckpts.inc()
 
-    if tracing:
-        jax.profiler.stop_trace()
-        log_fn(f"[train] wrote profiler trace to {trace_dir}")
+    trace_window.stop()
     if ckpt_dir and is_main:
-        _save_if_finite(Path(ckpt_dir) / f"ckpt_{int(state.step)}.npz",
-                        state, log_fn, final=True)
+        if _save_if_finite(Path(ckpt_dir) / f"ckpt_{int(state.step)}.npz",
+                           state, log_fn, final=True):
+            m_ckpts.inc()
+    if recompile_watch is not None:
+        recompile_watch.remove()
+        if recompile_watch.recompiles:
+            log_fn(f"[train] watchdog: {recompile_watch.recompiles} "
+                   f"recompile(s) after the first step — see run log")
+    if metrics_path and is_main:
+        # end-of-session registry snapshot: the record `tlm summary` reports
+        # and `tlm compare` diffs between two runs
+        with open(metrics_path, "a") as f:
+            f.write(json.dumps({"event": "run_end",
+                                "final_step": int(state.step),
+                                "metrics": registry.snapshot()},
+                               default=str) + "\n")
     return state
 
 
-def _save_if_finite(path: Path, state: TrainState, log_fn, final: bool = False):
+def _save_if_finite(path: Path, state: TrainState, log_fn,
+                    final: bool = False) -> bool:
     """Never persist poisoned params: a checkpoint written after NaN updates
     slipped through (apply_if_finite passes through after its error budget)
-    would later be resumed as the 'last good' state."""
+    would later be resumed as the 'last good' state.  Returns True when a
+    checkpoint was actually written."""
     host_state = jax.device_get(state)
     bad = [() for x in (jax.tree.leaves(host_state.params)
                         + jax.tree.leaves(host_state.bn_state))
@@ -250,9 +321,10 @@ def _save_if_finite(path: Path, state: TrainState, log_fn, final: bool = False):
     if bad:
         log_fn(f"[train] NOT saving {path}: {len(bad)} param tensor(s) "
                f"non-finite (diverged); last good checkpoint is unchanged")
-        return
+        return False
     save_checkpoint(path, host_state)
     log_fn(f"[train] saved {'final ' if final else ''}{path}")
+    return True
 
 
 def train_cli(args, config: RAFTConfig) -> int:
@@ -368,6 +440,7 @@ def train_cli(args, config: RAFTConfig) -> int:
     try:
         train(config, tconfig, batch_iter, ckpt_dir=ckpt_dir,
               trace_dir=getattr(args, "trace", None),
+              trace_steps=getattr(args, "trace_steps", None) or 4,
               init_params=init_params)
     finally:
         if mp_loader is not None:
@@ -380,9 +453,11 @@ def train_cli(args, config: RAFTConfig) -> int:
         records = []
         for ln in metrics_path.read_text().splitlines():
             try:
-                records.append(json.loads(ln))
+                rec = json.loads(ln)
             except json.JSONDecodeError:
-                pass   # partial line from a crash mid-append
+                continue   # partial line from a crash mid-append
+            if "step" in rec and "epe" in rec:   # skip manifest/run_end events
+                records.append(rec)
 
         if len(records) >= 2:
             first, last = records[0], records[-1]

@@ -15,15 +15,20 @@ import numpy as np
 
 
 def param_table(params, prefix: str = "") -> str:
-    """Human-readable table of every leaf: path, shape, #params."""
+    """Human-readable table of every leaf: path, shape, #params.
+
+    Edge cases that must not crash the flops CLI: an empty pytree ({} or
+    None) renders a TOTAL-0 table; scalar leaves — 0-d arrays AND plain
+    Python numbers, which have no ``.shape`` — count as 1 parameter."""
     rows = []
     total = 0
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     for path, leaf in flat:
         name = "/".join(str(getattr(k, "key", k)) for k in path)
-        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        shape = tuple(np.shape(leaf))     # () for scalars of any kind
+        n = int(np.prod(shape)) if shape else 1
         total += n
-        rows.append((prefix + name, str(tuple(leaf.shape)), n))
+        rows.append((prefix + name, str(shape), n))
     width = max((len(r[0]) for r in rows), default=10) + 2
     lines = [f"{'name':<{width}}{'shape':<20}{'#':>12}"]
     lines += [f"{n:<{width}}{s:<20}{c:>12,}" for n, s, c in rows]
@@ -32,22 +37,34 @@ def param_table(params, prefix: str = "") -> str:
 
 
 def count_params(params) -> int:
-    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    # np.shape (not .shape) so Python-scalar leaves count as 1, matching
+    # param_table; np.prod(()) == 1.0 handles 0-d arrays
+    return sum(int(np.prod(np.shape(x))) for x in jax.tree.leaves(params))
+
+
+def _normalize_costs(costs) -> Dict[str, float]:
+    """``compiled.cost_analysis()`` is backend-dependent: None (no analysis
+    on this backend), a per-device list (possibly empty), or a dict that
+    may omit any key.  Normalize all of that to a plain (possibly empty)
+    {name: float} dict so callers only handle one shape."""
+    if isinstance(costs, (list, tuple)):   # older jax: per-device list
+        costs = costs[0] if costs else None
+    if not costs:                          # None or {}
+        return {}
+    return {k: float(v) for k, v in costs.items()
+            if k in ("flops", "bytes accessed", "optimal_seconds")}
 
 
 def cost_analysis(fn: Callable, *args) -> Dict[str, float]:
-    """XLA cost analysis of the jitted ``fn(*args)``: flops, bytes accessed.
+    """XLA cost analysis of the jitted ``fn(*args)``: flops, bytes accessed
+    — {} when the backend provides no analysis.
 
     Note XLA counts a multiply-add as 2 flops (same caveat the reference
     logged about tf.profiler, infer_raft.py:93-95).
     """
     lowered = jax.jit(fn).lower(*args)
     compiled = lowered.compile()
-    costs = compiled.cost_analysis()
-    if isinstance(costs, list):   # older jax returns a per-device list
-        costs = costs[0]
-    return {k: float(v) for k, v in costs.items()
-            if k in ("flops", "bytes accessed", "optimal_seconds")}
+    return _normalize_costs(compiled.cost_analysis())
 
 
 def flops_report(fn: Callable, *args) -> Tuple[float, str]:

@@ -120,8 +120,11 @@ def test_demo_train_then_val_journey(tmp_path, capsys):
     metrics = tmp_path / "checkpoints" / "metrics.jsonl"
     records = [json.loads(ln) for ln in
                metrics.read_text().splitlines() if ln.strip()]
-    assert records and records[-1]["step"] == 1
-    assert np.isfinite(records[-1]["epe"])
+    # the stream opens with this run's telemetry manifest (OBSERVABILITY.md)
+    assert records[0].get("event") == "manifest" and records[0]["git_sha"]
+    step_recs = [r for r in records if "step" in r and "event" not in r]
+    assert step_recs and step_recs[-1]["step"] == 1
+    assert np.isfinite(step_recs[-1]["epe"])
     ckpt = tmp_path / "checkpoints" / "ckpt_2.npz"
     assert ckpt.exists()
 

@@ -274,6 +274,22 @@ from raft_tpu.lint.contracts import contract
 def f(x, radius=1):
     return x
 """),
+    ("R10", """
+def load_dataset(path, verbose=True):
+    if verbose:
+        print("scanning", path)
+    return path
+""", """
+from raft_tpu.telemetry.log import get_logger
+
+_log = get_logger("data")
+
+
+def load_dataset(path, verbose=True):
+    if verbose:
+        _log.info(f"scanning {path}")
+    return path
+"""),
 ]
 
 
@@ -329,6 +345,44 @@ def f(coords):
     return coords
 """
     assert "R9" in ids(scan_source(src))
+
+
+def test_r10_cli_surfaces_exempt():
+    """print() is the PRODUCT on CLI surfaces: files named cli.py, files
+    with a __main__ guard (every tools/ script), and main/*_cli handler
+    functions all keep printing; library code does not."""
+    bare = "def helper(x):\n    print(x)\n    return x\n"
+    assert "R10" in ids(scan_source(bare))
+    # same code in a file named cli.py -> exempt
+    assert "R10" not in ids(scan_source(bare, path="raft_tpu/cli.py"))
+    # a script (top-level __main__ guard anywhere in the file) -> exempt
+    script = bare + "\nif __name__ == \"__main__\":\n    helper(1)\n"
+    assert "R10" not in ids(scan_source(script, path="tools/thing.py"))
+    # CLI handler functions by naming convention -> exempt
+    assert "R10" not in ids(scan_source(
+        "def main():\n    print('usage')\n"))
+    assert "R10" not in ids(scan_source(
+        "def train_cli(args):\n    print('step')\n"))
+    # ...but only for the handler itself, not its file's other functions
+    assert "R10" in ids(scan_source(
+        "def train_cli(args):\n    print('ok')\n\n"
+        "def library_fn(x):\n    print(x)\n"))
+
+
+def test_r10_traced_print_is_r1s_domain():
+    """A print inside jit-traced code is a trace-time side effect (R1), not
+    a logging-style violation — R10 must not double-report it."""
+    src = """
+import jax
+
+@jax.jit
+def f(x):
+    print("traced", x)
+    return x
+"""
+    found = ids(scan_source(src))
+    assert "R1" in found
+    assert "R10" not in found
 
 
 def test_eight_plus_distinct_rules_covered():

@@ -13,7 +13,8 @@ from raft_tpu.config import RAFTConfig, TrainConfig
 from raft_tpu.models import init_raft
 from raft_tpu.models.raft import make_inference_fn
 from raft_tpu.ops import build_pyramid, conv2d, coords_grid, lookup_dense
-from raft_tpu.parallel import (SPATIAL_AXIS, conv2d_row_sharded, halo_exchange,
+from raft_tpu.parallel import (SPATIAL_AXIS, compat_shard_map,
+                               conv2d_row_sharded, halo_exchange,
                                make_dp_eval_fn, make_dp_train_step, make_mesh,
                                make_spatial_corr_lookup,
                                make_spatial_inference_fn, shard_batch)
@@ -139,10 +140,10 @@ def test_halo_exchange_matches_full_conv():
     want = conv2d(x, w)
 
     mesh = make_mesh(axes=(SPATIAL_AXIS,))
-    f = jax.shard_map(
+    f = compat_shard_map(
         lambda xl: conv2d_row_sharded(xl, w),
         mesh=mesh, in_specs=P(None, SPATIAL_AXIS),
-        out_specs=P(None, SPATIAL_AXIS), check_vma=False)
+        out_specs=P(None, SPATIAL_AXIS))
     got = jax.jit(f)(x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-4, rtol=1e-4)
@@ -323,11 +324,11 @@ def test_ring_lookup_via_fused_kernel_matches_dense():
                              p_select="window", pack_rows=True))
         return lk(cl)
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(compat_shard_map(
         inner, mesh=mesh,
         in_specs=(P(None, SPATIAL_AXIS), P(None, SPATIAL_AXIS),
                   P(None, SPATIAL_AXIS)),
-        out_specs=P(None, SPATIAL_AXIS), check_vma=False))
+        out_specs=P(None, SPATIAL_AXIS)))
     got = np.asarray(f(f1, f2, coords)).reshape(np.asarray(want).shape)
     np.testing.assert_allclose(got, np.asarray(want), atol=1e-4, rtol=1e-4)
 

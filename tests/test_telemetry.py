@@ -1,0 +1,459 @@
+"""Telemetry spine tests (OBSERVABILITY.md): registry primitives + default
+registry, run manifests + event logs, named-stage tracing, the trace
+window, the watchdogs (NaN sentinel + recompile counter, both with stage
+provenance), the training loop's metrics.jsonl provenance, and tools/tlm.
+
+Acceptance-criteria anchors:
+* a deliberately-injected NaN is surfaced with the stage that produced it;
+* a deliberately-triggered recompile is surfaced with the stage active at
+  compile time;
+* train metrics.jsonl carries a manifest (git sha, jax version, device
+  kind, config hash);
+* tlm summary/compare work end-to-end on real run logs.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from raft_tpu.telemetry import (Counter, Registry, RunLog,  # noqa: E402
+                                config_hash, default_registry, read_events,
+                                run_manifest)
+from raft_tpu.telemetry import events as tlm_events  # noqa: E402
+from raft_tpu.telemetry import watchdogs as wd  # noqa: E402
+from raft_tpu.telemetry.trace import (TraceWindow, current_stage,  # noqa: E402
+                                      stage)
+
+
+# ------------------------------------------------------------- registry --
+
+def test_registry_snapshot_plain_and_labeled():
+    reg = Registry()
+    c = reg.counter("jobs_total", "jobs")
+    g = reg.gauge("depth", "queue depth")
+    h = reg.histogram("lat", "latency", buckets=(0.1, 1.0))
+    lab = reg.counter("by_status", "statuses", labelnames=("status",))
+    c.inc(3)
+    g.set(2.5)
+    h.observe(0.05)
+    h.observe(5.0)
+    lab.labels("ok").inc(2)
+    lab.labels("shed").inc()
+    snap = reg.snapshot()
+    assert snap["jobs_total"] == 3.0
+    assert snap["depth"] == 2.5
+    assert snap["lat"] == {"count": 2, "sum": 5.05, "mean": 2.525}
+    assert snap["by_status"] == {"ok": 2.0, "shed": 1.0}
+
+
+def test_default_registry_is_shared_and_get_or_create_works():
+    reg = default_registry()
+    assert default_registry() is reg
+    name = "test_default_reg_counter"
+    c = reg.get_or_counter(name, "test")
+    assert reg.get_or_counter(name, "test") is c
+    assert isinstance(c, Counter)
+
+    # atomicity under contention: concurrent first-creation must never hit
+    # the duplicate-metric ValueError (the mp_loader shared-counter path)
+    import threading
+    results, errors = [], []
+
+    def create(i):
+        try:
+            results.append(reg.get_or_counter("test_contended_counter", "t"))
+        except ValueError as e:   # pragma: no cover — the bug this guards
+            errors.append(e)
+
+    threads = [threading.Thread(target=create, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(set(map(id, results))) == 1
+
+
+def test_serving_shim_reexports_telemetry_classes():
+    # the compat contract: serving imports ARE the telemetry classes, so
+    # /metrics rendering and tlm snapshots share one implementation
+    from raft_tpu.serving import metrics as serving_metrics
+    from raft_tpu.telemetry import registry as tel
+    assert serving_metrics.Counter is tel.Counter
+    assert serving_metrics.Histogram is tel.Histogram
+    assert serving_metrics.Registry is tel.Registry
+
+
+# ---------------------------------------------------- manifests / events --
+
+def test_config_hash_stable_and_sensitive():
+    from raft_tpu.config import RAFTConfig
+    a = RAFTConfig.full()
+    assert config_hash(a) == config_hash(RAFTConfig.full())
+    assert config_hash(a) != config_hash(RAFTConfig.full(iters=7))
+    assert config_hash(None) is None
+    assert config_hash({"k": 1}) == config_hash({"k": 1})
+
+
+def test_run_manifest_provenance_fields():
+    from raft_tpu.config import RAFTConfig
+    man = run_manifest(config=RAFTConfig.small_model(), mode="test",
+                      extra={"note": "x"})
+    sha = subprocess.run(["git", "rev-parse", "HEAD"], cwd=REPO,
+                         capture_output=True, text=True).stdout.strip()
+    assert man["git_sha"] == sha
+    import jax
+    assert man["jax_version"] == jax.__version__
+    assert man["device_kind"] == jax.devices()[0].device_kind
+    assert man["device_count"] == len(jax.devices())
+    assert len(man["config_hash"]) == 16
+    assert man["mode"] == "test" and man["note"] == "x"
+    assert man["schema"] == 1 and man["argv"]
+
+
+def test_run_manifest_probe_device_false_never_touches_jax():
+    man = run_manifest(mode="bench", probe_device=False)
+    assert man["device_kind"] is None and man["backend"] is None
+    assert man["git_sha"]          # provenance survives without a device
+
+
+def test_runlog_roundtrip_and_partial_line_tolerance(tmp_path):
+    log = RunLog(tmp_path / "run", manifest=run_manifest(mode="t"))
+    log.event("custom", value=3)
+    log.close()
+    path = tmp_path / "run" / "events.jsonl"
+    assert path.exists()
+    # simulate a crash mid-append: partial trailing line
+    with open(path, "a") as f:
+        f.write('{"t": 1, "event": "trunc')
+    recs = read_events(tmp_path / "run")
+    assert [r["event"] for r in recs] == ["manifest", "custom"]
+    assert recs[1]["value"] == 3
+    assert all("t" in r for r in recs)
+
+
+def test_events_current_is_settable(tmp_path):
+    assert tlm_events.current() is None or True   # whatever prior state
+    log = RunLog(tmp_path)
+    tlm_events.set_current(log)
+    try:
+        assert tlm_events.current() is log
+    finally:
+        tlm_events.set_current(None)
+        log.close()
+
+
+# ------------------------------------------------------------- tracing ---
+
+def test_stage_stack_nesting_and_thread_locality():
+    assert current_stage() is None
+    with stage("a"):
+        assert current_stage() == "a"
+        with stage("a/b"):
+            assert current_stage() == "a/b"
+        assert current_stage() == "a"
+    assert current_stage() is None
+
+    import threading
+    seen = []
+
+    def other():
+        seen.append(current_stage())
+
+    with stage("main-only"):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    assert seen == [None]           # the stack is per-thread
+
+
+def test_stage_under_jit_and_as_decorator():
+    import jax
+    import jax.numpy as jnp
+
+    @stage("decorated")
+    def double(x):
+        assert current_stage() == "decorated"
+        return x * 2
+
+    @jax.jit
+    def f(x):
+        with stage("inner"):
+            y = double(x)
+        return y
+
+    np.testing.assert_allclose(np.asarray(f(jnp.ones(3))), 2.0)
+
+
+def test_trace_window_none_dir_is_noop_and_window_fires(monkeypatch):
+    calls = []
+    import jax
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop", None)))
+
+    noop = TraceWindow(None, first=0, steps=2)
+    for i in range(5):
+        assert noop.on_step(i) is False
+    noop.stop()
+    assert calls == []
+
+    msgs = []
+    tw = TraceWindow("/tmp/tracedir", first=2, steps=2, log_fn=msgs.append)
+    assert tw.on_step(0) is False and tw.on_step(1) is False
+    assert tw.on_step(2) is True and tw.on_step(3) is True
+    assert tw.on_step(4) is False          # window closed itself
+    tw.stop()                              # idempotent
+    assert calls == [("start", "/tmp/tracedir"), ("stop", None)]
+    assert any("trace" in m for m in msgs)
+
+
+# ------------------------------------------------------------ watchdogs --
+
+@pytest.fixture
+def nan_sentinel():
+    wd.enable_nan_sentinel(True)
+    yield
+    wd.enable_nan_sentinel(False)
+
+
+def test_nan_guard_free_when_disabled():
+    wd.enable_nan_sentinel(False)
+    x = object()                      # not even an array: guard must be id
+    assert wd.nan_guard(x) is x
+
+
+def test_nan_sentinel_reports_stage_provenance(nan_sentinel, tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    log = RunLog(tmp_path)
+    wd.enable_nan_sentinel(True, run_log=log)
+
+    @jax.jit
+    def f(x):
+        with stage("demo/fused"):
+            y = wd.nan_guard(x * 2)
+        return y
+
+    f(jnp.array([1.0, jnp.inf, jnp.nan])).block_until_ready()
+    jax.effects_barrier()
+    evs = wd.nan_events()
+    assert evs and evs[-1]["stage"] == "demo/fused"
+    assert evs[-1]["bad_values"] == 2
+    log.close()
+    recs = read_events(tmp_path)
+    assert any(r.get("event") == "nonfinite"
+               and r.get("stage") == "demo/fused" for r in recs)
+    # clean input -> no new events
+    before = len(wd.nan_events())
+    f(jnp.ones(3)).block_until_ready()
+    jax.effects_barrier()
+    assert len(wd.nan_events()) == before
+
+
+def test_model_level_nan_carries_model_stage(nan_sentinel):
+    """ACCEPTANCE: a deliberately-injected NaN in the model input is
+    surfaced with the model stage that first produced non-finite values
+    (raft/fnet — the guard threaded through models/raft.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import init_raft
+    from raft_tpu.models.raft import make_inference_fn
+
+    config = RAFTConfig.small_model(iters=1)
+    params = init_raft(jax.random.PRNGKey(0), config)
+    fn = jax.jit(make_inference_fn(config))
+    im = jnp.zeros((1, 32, 48, 3), jnp.float32)
+    bad = im.at[0, 0, 0, 0].set(jnp.nan)
+    wd.nan_events().clear()
+    fn(params, bad, im).block_until_ready()
+    jax.effects_barrier()
+    stages = [e["stage"] for e in wd.nan_events()]
+    assert stages and stages[0] == "raft/fnet", stages
+
+
+def test_recompile_watch_counts_and_attributes_stage(tmp_path):
+    """ACCEPTANCE: a deliberately-triggered recompile (new input shape
+    after arm()) is surfaced with the host-side stage active at compile
+    time, while warmup compiles are counted separately."""
+    import jax
+    import jax.numpy as jnp
+
+    log = RunLog(tmp_path)
+    watch = wd.RecompileWatch(run_log=log, log_fn=lambda m: None).install()
+    try:
+        f = jax.jit(lambda x: (x * 3).sum())
+        f(jnp.ones((4,))).block_until_ready()      # expected warmup compile
+        assert watch.recompiles == 0
+        assert watch.warmup_compiles >= 1
+        watch.arm()
+        with stage("eval/forward"):
+            f(jnp.ones((9,))).block_until_ready()  # new shape -> recompile
+        assert watch.recompiles >= 1
+        assert watch.events[0]["stage"] == "eval/forward"
+        assert watch.events[0]["duration_s"] >= 0
+        # cache hit: no new recompile
+        n = watch.recompiles
+        f(jnp.ones((9,))).block_until_ready()
+        assert watch.recompiles == n
+    finally:
+        watch.remove()
+        log.close()
+    recs = read_events(tmp_path)
+    assert any(r.get("event") == "recompile"
+               and r.get("stage") == "eval/forward" for r in recs)
+
+
+def test_hbm_gauges_none_safe():
+    reg = Registry()
+    gauges = wd.hbm_gauges(reg)
+    # CPU backend: memory_stats() is None -> gauges read 0, never raise
+    assert gauges["bytes_in_use"].value >= 0
+    assert "raft_hbm_bytes_in_use" in reg.render()
+
+
+def test_transfer_watch_levels():
+    with wd.transfer_watch("log"):
+        pass
+    with pytest.raises(ValueError, match="log.*disallow|disallow.*log"):
+        wd.transfer_watch("everything")
+
+
+# ------------------------------------------- train-loop integration ------
+
+@pytest.mark.slow
+def test_train_metrics_jsonl_carries_manifest_and_snapshot(tmp_path):
+    """ACCEPTANCE: metrics.jsonl written by the training loop starts with a
+    manifest record (git sha, jax version, device kind, config hash) and
+    ends with the registry snapshot."""
+    import jax
+
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.training.loop import train
+
+    config = RAFTConfig.small_model(iters=2)
+    tconfig = TrainConfig(num_steps=2, batch_size=1, lr=1e-4,
+                          schedule="constant", log_every=1, ckpt_every=100)
+    rng = np.random.RandomState(0)
+    B, H, W = 1, 32, 48
+
+    def batches():
+        while True:
+            yield (rng.rand(B, H, W, 3).astype(np.float32),
+                   rng.rand(B, H, W, 3).astype(np.float32),
+                   (rng.randn(B, H, W, 2) * 2).astype(np.float32),
+                   np.ones((B, H, W), np.float32))
+
+    train(config, tconfig, batches(), ckpt_dir=str(tmp_path),
+          data_parallel=False, log_fn=lambda m: None)
+
+    recs = [json.loads(ln) for ln in
+            (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    assert recs[0]["event"] == "manifest"
+    man = recs[0]
+    assert man["git_sha"] and man["jax_version"] == jax.__version__
+    assert man["device_kind"] == jax.devices()[0].device_kind
+    assert len(man["config_hash"]) == 16
+    assert man["mode"] == "train" and man["tconfig_hash"]
+    steps = [r for r in recs if "step" in r and "event" not in r]
+    assert [r["step"] for r in steps] == [0, 1]
+    end = recs[-1]
+    assert end["event"] == "run_end" and end["final_step"] == 2
+    assert end["metrics"]["raft_train_steps_total"] == 2.0
+    assert end["metrics"]["raft_train_nonfinite_total"] == 0.0
+
+
+# ------------------------------------------------------------- tlm -------
+
+def _load_tlm():
+    spec = importlib.util.spec_from_file_location(
+        "tlm", REPO / "tools" / "tlm.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fake_run(tmp_path, name, sha, epe):
+    d = tmp_path / name
+    d.mkdir()
+    man = run_manifest(mode="train", probe_device=False)
+    man["git_sha"] = sha
+    man["config_hash"] = "cafe" * 4
+    lines = [
+        {"t": 1.0, "event": "manifest", **man},
+        {"step": 0, "loss": 10.0, "epe": epe + 1.0},
+        {"step": 1, "loss": 5.0, "epe": epe},
+        {"t": 2.0, "event": "run_end", "final_step": 2,
+         "metrics": {"raft_train_steps_total": 2.0}},
+    ]
+    (d / "events.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in lines))
+    return d
+
+
+def test_tlm_summary_reports_provenance_and_trajectory(tmp_path):
+    tlm = _load_tlm()
+    a = _fake_run(tmp_path, "a", "a" * 40, epe=2.0)
+    out = "\n".join(tlm.summary_lines(a))
+    assert "a" * 40 in out
+    assert "cafecafecafecafe" in out
+    assert "steps 0 -> 1" in out
+    assert "raft_train_steps_total" in out
+
+
+def test_tlm_compare_diffs_provenance_and_numbers(tmp_path):
+    tlm = _load_tlm()
+    a = _fake_run(tmp_path, "a", "a" * 40, epe=2.0)
+    b = _fake_run(tmp_path, "b", "b" * 40, epe=1.0)
+    lines, comparable = tlm.compare_lines(a, b)
+    out = "\n".join(lines)
+    assert comparable
+    assert "git_sha" in out and "a" * 40 in out and "b" * 40 in out
+    assert "final.epe" in out and "-50.0%" in out
+    assert "(same)" in out          # identical values reported as such
+
+
+def test_tlm_handles_bench_json_and_missing_manifest(tmp_path):
+    tlm = _load_tlm()
+    bench = tmp_path / "BENCH_test.json"
+    bench.write_text(json.dumps({
+        "metric": "inference throughput", "value": 3.25,
+        "unit": "pairs/sec/chip",
+        "manifest": run_manifest(mode="bench", probe_device=False)}))
+    out = "\n".join(tlm.summary_lines(bench))
+    assert "3.25" in out and "git_sha" in " ".join(tlm.MANIFEST_FIELDS) \
+        or "git_sha" in out
+    legacy = tmp_path / "BENCH_old.json"
+    legacy.write_text(json.dumps({"metric": "x", "value": 1.0}))
+    lines, comparable = tlm.compare_lines(bench, legacy)
+    assert not comparable           # provenance unknown on one side
+    assert any("manifest missing" in ln for ln in lines)
+
+
+def test_tlm_cli_roundtrip(tmp_path):
+    a = _fake_run(tmp_path, "a", "1" * 40, epe=3.0)
+    b = _fake_run(tmp_path, "b", "2" * 40, epe=2.0)
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "tlm.py"), "compare",
+         str(a), str(b)], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "git_sha" in out.stdout
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "tlm.py"), "tail",
+         str(a), "-n", "2"], capture_output=True, text=True)
+    assert out.returncode == 0
+    assert "run_end" in out.stdout

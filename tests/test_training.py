@@ -342,8 +342,13 @@ def test_metrics_stream_truncated_for_fresh_run(tmp_path):
           ckpt_dir=str(ckpt), data_parallel=False, log_fn=lambda *_: None)
     records = [json.loads(l) for l in
                (ckpt / "metrics.jsonl").read_text().splitlines()]
-    assert [r["step"] for r in records] == [0, 1]
-    assert all("epe" in r for r in records)   # no stale schema-less records
+    # fresh-run stream: this session's manifest first (the dead run's purged
+    # — telemetry provenance, OBSERVABILITY.md), then one record per step
+    assert records[0]["event"] == "manifest"
+    step_recs = [r for r in records if "step" in r and "event" not in r]
+    assert [r["step"] for r in step_recs] == [0, 1]
+    assert all("epe" in r for r in step_recs)   # no stale schema-less records
+    assert sum(r.get("event") == "manifest" for r in records) == 1
 
 
 @pytest.mark.slow
@@ -619,8 +624,11 @@ def test_train_crash_resume_end_to_end(tmp_path):
     assert int(state.step) == 10
     assert any("resumed" in line and "at step 6" in line for line in logs)
     records = [json.loads(l) for l in (ckpt / "metrics.jsonl").read_text().splitlines()]
-    assert records[0]["step"] == 0 and records[-1]["step"] == 9
-    assert all(np.isfinite(r["loss"]) for r in records)
+    step_recs = [r for r in records if "step" in r and "event" not in r]
+    assert step_recs[0]["step"] == 0 and step_recs[-1]["step"] == 9
+    assert all(np.isfinite(r["loss"]) for r in step_recs)
+    # one manifest per session (initial run + resume), both kept
+    assert sum(r.get("event") == "manifest" for r in records) == 2
 
 
 @pytest.mark.slow
@@ -654,9 +662,15 @@ def test_metrics_stream_truncated_on_resume(tmp_path):
     assert any("resumed" in line and "at step 4" in line for line in logs)
     assert any("dropped" in line and "replayed" in line for line in logs)
     records = [json.loads(l) for l in (ckpt / "metrics.jsonl").read_text().splitlines()]
-    steps = [r["step"] for r in records]
+    steps = [r["step"] for r in records if "step" in r and "event" not in r]
     assert steps == sorted(set(steps)), steps   # strictly increasing, no dups
     assert steps[-1] == 7
+    # the crashed session's run_end (final_step 6 > resume point 4) was
+    # purged with its replayed step records; its manifest (start_step 0 <
+    # 4) survives, as does the resumed session's
+    assert sum(r.get("event") == "manifest" for r in records) == 2
+    ends = [r for r in records if r.get("event") == "run_end"]
+    assert len(ends) == 1 and ends[0]["final_step"] == 8
 
 
 class _MixedSizeSparseValidDataset(_MixedResolutionDataset):

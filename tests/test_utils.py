@@ -253,3 +253,66 @@ def test_pfm_write_read_roundtrip(tmp_path):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="PFM holds"):
         write_pfm(rng.randn(4, 4, 2).astype(np.float32), tmp_path / "x.pfm")
+
+
+# ------------------------------------------------- utils.profiling ------
+
+
+def test_param_table_normal_tree():
+    from raft_tpu.utils.profiling import count_params, param_table
+
+    params = {"layer": {"w": np.zeros((3, 4)), "b": np.zeros((4,))}}
+    table = param_table(params)
+    assert "layer/w" in table and "(3, 4)" in table
+    assert "TOTAL" in table and "16" in table
+    assert count_params(params) == 16
+
+
+def test_param_table_empty_and_scalar_leaves():
+    """The flops CLI must not crash on degenerate pytrees: {} / None render
+    a TOTAL-0 table; 0-d arrays and plain Python scalars (no .shape at all)
+    each count as one parameter."""
+    from raft_tpu.utils.profiling import count_params, param_table
+
+    for empty in ({}, None, []):
+        table = param_table(empty)
+        assert "TOTAL" in table and "0" in table.splitlines()[-1]
+        assert count_params(empty) == 0
+
+    scalars = {"a": np.float32(2.0), "b": 3.5, "c": np.zeros(())}
+    table = param_table(scalars)
+    assert count_params(scalars) == 3
+    assert table.splitlines()[-1].split()[-1] == "3"
+    assert "()" in table          # scalar shape rendered, not crashed
+
+
+def test_normalize_costs_shapes():
+    """cost_analysis() return shapes seen across jax/backends: None, empty
+    per-device list, per-device list of dicts, dict missing 'flops' — all
+    normalize to a plain dict, never raise."""
+    from raft_tpu.utils.profiling import _normalize_costs
+
+    assert _normalize_costs(None) == {}
+    assert _normalize_costs([]) == {}
+    assert _normalize_costs({}) == {}
+    assert _normalize_costs([{"flops": 8.0, "other": 1.0}]) == {"flops": 8.0}
+    out = _normalize_costs({"bytes accessed": 64, "utilization": 0.5})
+    assert out == {"bytes accessed": 64.0}        # no flops key -> omitted
+
+
+def test_cost_analysis_and_flops_report_live():
+    """End-to-end on the real backend: whatever this backend's
+    cost_analysis returns (full dict on CPU/TPU, None on some), the
+    helpers return a dict / a finite-or-nan flops without raising."""
+    import jax.numpy as jnp
+
+    from raft_tpu.utils.profiling import cost_analysis, flops_report
+
+    def fn(x):
+        return x @ x
+
+    costs = cost_analysis(fn, jnp.ones((8, 8), jnp.float32))
+    assert isinstance(costs, dict)
+    flops, msg = flops_report(fn, jnp.ones((8, 8), jnp.float32))
+    assert "flops" in msg
+    assert isinstance(flops, float)       # a number or nan, never a raise

@@ -56,20 +56,36 @@ run() {  # run <name> <timeout_s> <cmd...>
   grep -q '^rc=0 ' "$OUT/$name.log" || all_ok=0
 }
 
-# 1. Mosaic lowering parity — highest-risk unknown, run first.
-run hw_smoke       1500 python tools/hw_smoke.py --full
+# 1. Mosaic lowering parity — highest-risk unknown, run first.  The
+#    machine-readable verdict JSON (per-gate pass/fail + manifest) is what
+#    the kernel sweeps below gate on — not a grep of this stage's stdout.
+run hw_smoke       1500 python tools/hw_smoke.py --full --json "$OUT/hw_smoke_verdict.json"
 # 2. Null-call floor + per-stage attribution (eval + train shapes).
 run profile_eval   1500 python tools/profile_breakdown.py
 run profile_train  1500 python tools/profile_breakdown.py --size 368 496 --batch 6
 # 3. Window/pack sweeps (quick: the full grid was measured in round 2;
-#    only the new schedules need numbers).
-run tune_window    1800 python tools/tune_pallas.py --quick --precision default --p-select window
-run tune_winpack   1800 python tools/tune_pallas.py --quick --precision default --p-select window --pack
-run tune_pack      1800 python tools/tune_pallas.py --quick --precision default --pack
-#    Round-6 addition: block_rows sweep of the fused SepConvGRU update
-#    kernel (the GRU-bound regime's hot stage; xla-vs-pallas per-iteration
-#    table) — hw_smoke above already gated its Mosaic lowering.
-run tune_gru       1800 python tools/tune_pallas.py --kernel gru
+#    only the new schedules need numbers) — gated on the hw_smoke verdict:
+#    sweeping a kernel whose Mosaic lowering just failed parity would burn
+#    the tunnel window measuring wrong numerics.
+if python - "$OUT/hw_smoke_verdict.json" <<'PYEOF'
+import json, sys
+try:
+    sys.exit(0 if json.load(open(sys.argv[1])).get("all_ok") else 1)
+except Exception:
+    sys.exit(1)
+PYEOF
+then
+  run tune_window    1800 python tools/tune_pallas.py --quick --precision default --p-select window
+  run tune_winpack   1800 python tools/tune_pallas.py --quick --precision default --p-select window --pack
+  run tune_pack      1800 python tools/tune_pallas.py --quick --precision default --pack
+  #  Round-6 addition: block_rows sweep of the fused SepConvGRU update
+  #  kernel (the GRU-bound regime's hot stage; xla-vs-pallas per-iteration
+  #  table) — the hw_smoke verdict above already gated its Mosaic lowering.
+  run tune_gru       1800 python tools/tune_pallas.py --kernel gru
+else
+  echo "=== kernel sweeps: hw_smoke verdict not all_ok, skipping ==="
+  all_ok=0
+fi
 # 4. Headline inference bench (writes its own JSON line).
 run bench          2400 python bench.py
 # 5. Train-step throughput at the official shape, incl. accum overhead.

@@ -9,12 +9,18 @@ accumulation; for the GRU kernel: clamped neighbor-block index maps, halo
 concats/slices, the merged [rows*W, C] tap matmuls) — run it first whenever
 a kernel changes, before spending tunnel time on sweeps.
 
+Alongside the human-readable lines, a machine-readable verdict JSON —
+per-gate pass/fail + the run manifest — is written to ``--json`` (default
+``hw_smoke_verdict.json``), which ``tools/hw_queue.sh`` gates the kernel
+sweeps on instead of grepping stdout.
+
 Usage: python tools/hw_smoke.py [--full]   (--full adds the training shape)
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -23,10 +29,29 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _write_verdict(path: str, gates: list, error: str = None) -> None:
+    """Per-gate pass/fail + manifest; written on EVERY exit path (a missing
+    file reads as 'smoke never ran', not 'smoke passed')."""
+    from raft_tpu.telemetry import run_manifest
+    verdict = {
+        "all_ok": bool(gates) and all(g["ok"] for g in gates) and not error,
+        "gates": gates,
+        "error": error,
+        "manifest": run_manifest(mode="hw_smoke",
+                                 probe_device=error is None),
+    }
+    with open(path, "w") as f:
+        json.dump(verdict, f, indent=2)
+    print(f"# verdict written to {path}", flush=True)
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--full", action="store_true",
                    help="also run the batch-6 training shape")
+    p.add_argument("--json", default="hw_smoke_verdict.json", metavar="PATH",
+                   help="machine-readable verdict file (per-gate pass/fail "
+                        "+ manifest; hw_queue.sh gates on it)")
     args = p.parse_args()
 
     import jax
@@ -34,7 +59,9 @@ def main() -> int:
 
     if jax.default_backend() != "tpu":
         print("ERROR: hw_smoke needs the TPU backend", file=sys.stderr)
+        _write_verdict(args.json, [], error="TPU backend unavailable")
         return 2
+    gates = []
 
     from raft_tpu.ops.coords import coords_grid
     from raft_tpu.ops.corr import build_pyramid, fmap2_pyramid, lookup_dense
@@ -73,10 +100,14 @@ def main() -> int:
                 ok = err < 1e-4
                 print(f"{label}  {name:<12} max|err|={err:.2e}  "
                       f"{'OK' if ok else 'FAIL'}", flush=True)
+                gates.append({"gate": f"corr {label} {name}", "ok": bool(ok),
+                              "max_err": float(err)})
                 failures += (not ok)
             except Exception as e:   # noqa: BLE001 — report every combo
                 print(f"{label}  {name:<12} RAISED {type(e).__name__}: "
                       f"{str(e)[:200]}", flush=True)
+                gates.append({"gate": f"corr {label} {name}", "ok": False,
+                              "raised": f"{type(e).__name__}: {str(e)[:200]}"})
                 failures += 1
 
     # --- fused SepConvGRU update kernel (ops/gru_pallas.py): Mosaic
@@ -115,13 +146,19 @@ def main() -> int:
                     ok = err < tol
                     print(f"{label}  {name:<16} max|err|={err:.2e}  "
                           f"{'OK' if ok else 'FAIL'}", flush=True)
+                    gates.append({"gate": f"gru {label} {name}",
+                                  "ok": bool(ok), "max_err": float(err)})
                     failures += (not ok)
                 except Exception as e:   # noqa: BLE001 — report every combo
                     print(f"{label}  {name:<16} RAISED {type(e).__name__}: "
                           f"{str(e)[:200]}", flush=True)
+                    gates.append({"gate": f"gru {label} {name}", "ok": False,
+                                  "raised": f"{type(e).__name__}: "
+                                            f"{str(e)[:200]}"})
                     failures += 1
 
     print(f"# {failures} failures", flush=True)
+    _write_verdict(args.json, gates)
     return 1 if failures else 0
 
 

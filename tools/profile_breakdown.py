@@ -32,6 +32,12 @@ def main():
     p.add_argument("--impl", default="pallas-bf16corr")
     p.add_argument("--unroll", type=int, default=1)
     p.add_argument("--cpu", action="store_true")
+    p.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="also capture a jax.profiler trace of the iters=12 "
+                        "steady-state reps — ops carry the raft/*, update/*, "
+                        "corr/* named-scope prefixes (telemetry.trace), so "
+                        "xprof attributes time per stage")
+    p.add_argument("--trace-steps", type=int, default=4)
     args = p.parse_args()
 
     if args.cpu:
@@ -69,7 +75,13 @@ def main():
     for iters in (1, 2, 8, 12):
         fn = jax.jit(make_inference_fn(cfg, iters=iters))
         compiled = fn.lower(params, im1, im2).compile()
-        dt = measure(compiled, (params, im1, im2))
+        trace = None
+        if args.trace_dir and iters == 12:
+            from raft_tpu.telemetry.trace import TraceWindow
+            trace = TraceWindow(args.trace_dir, first=0,
+                                steps=args.trace_steps,
+                                log_fn=lambda m: print(f"# {m}", flush=True))
+        dt = measure(compiled, (params, im1, im2), trace=trace)
         times[iters] = dt
         print(f"  iters={iters:2d}: {dt * 1e3:8.3f} ms", flush=True)
 

@@ -261,6 +261,14 @@ def main() -> int:
         "shed_429": int(prom.get(
             'raft_serving_requests_total{status="shed"}', 0)),
     }
+    # provenance (OBSERVABILITY.md): every BENCH_serving.json record carries
+    # the run manifest — git sha, jax versions, device, config hash — so the
+    # serving trajectory is attributable.  For --url (external server) the
+    # config hash is the client's view (None): the server's config is not
+    # observable over HTTP.
+    from raft_tpu.telemetry import run_manifest
+    rec["manifest"] = run_manifest(
+        config=None if args.url else config, mode="serve_bench")
     print(json.dumps(rec, indent=2))
     if args.out:
         with open(args.out, "a") as f:

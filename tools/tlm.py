@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""tlm — tail / summarize / compare telemetry run logs (OBSERVABILITY.md).
+
+Works on every artifact the stack stamps a manifest into:
+
+* run-event logs — ``events.jsonl`` written by every CLI mode (a directory
+  containing one, or the file itself);
+* training ``metrics.jsonl`` streams (manifest record + per-step records +
+  the end-of-run registry snapshot);
+* ``BENCH_*.json`` / ``BENCH_serving.json`` — single JSON objects or
+  JSONL appends with a ``"manifest"`` key.
+
+Usage:
+    python tools/tlm.py tail PATH [-n N]
+    python tools/tlm.py summary PATH
+    python tools/tlm.py compare A B
+
+``summary`` prints the manifest (provenance: git sha, jax version, device,
+config hash), per-event-kind counts, and whatever run result the log holds
+(final metric snapshot, step trajectory, bench headline).  ``compare``
+diffs two runs field-by-field: manifest provenance first (did the commit /
+config / device change?), then the numeric results.
+
+Pure stdlib and importable — no jax required, so it runs in the lint-tier
+CI job and on a laptop without the training environment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_records(path) -> List[dict]:
+    """Tolerant loader: a directory (events.jsonl, else metrics.jsonl
+    inside), a .jsonl stream, or a file holding one JSON object.  Partial
+    trailing lines (crash mid-append) are dropped, never fatal."""
+    p = Path(path)
+    if p.is_dir():
+        # a run output dir (--out): merge the event log with the training
+        # metrics stream(s) one level down, so one `tlm summary <out>` sees
+        # both the provenance and the step trajectory
+        streams = [q for q in
+                   [p / "events.jsonl", p / "metrics.jsonl"]
+                   + sorted(p.glob("*/metrics.jsonl")) if q.exists()]
+        if not streams:
+            raise FileNotFoundError(
+                f"{path}: no events.jsonl or */metrics.jsonl inside")
+        records = []
+        for q in streams:
+            records.extend(load_records(q))
+        return records
+    text = p.read_text()
+    records = []
+    try:
+        one = json.loads(text)
+        return one if isinstance(one, list) else [one]
+    except json.JSONDecodeError:
+        pass
+    for ln in text.splitlines():
+        if not ln.strip():
+            continue
+        try:
+            records.append(json.loads(ln))
+        except json.JSONDecodeError:
+            pass
+    return records
+
+
+MANIFEST_FIELDS = ("git_sha", "mode", "time", "config_hash", "backend",
+                   "device_kind", "device_count", "jax_version",
+                   "jaxlib_version", "python")
+
+
+def manifest_of(records: List[dict]) -> Optional[dict]:
+    """The LAST manifest in the stream (append-only logs carry one per
+    session; the latest describes the segment the results belong to).
+    Accepts both the event form ({"event": "manifest", ...fields}) and the
+    embedded form ({"manifest": {...}} — bench JSONs)."""
+    found = None
+    for rec in records:
+        if rec.get("event") == "manifest":
+            found = rec
+        elif isinstance(rec.get("manifest"), dict):
+            found = rec["manifest"]
+    return found
+
+
+def _step_records(records: List[dict]) -> List[dict]:
+    return [r for r in records if "step" in r and "event" not in r]
+
+
+def _fmt_val(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def summary_lines(path) -> List[str]:
+    records = load_records(path)
+    out = [f"== {path} ({len(records)} record(s))"]
+    man = manifest_of(records)
+    if man is None:
+        out.append("  manifest: MISSING (pre-telemetry artifact?)")
+    else:
+        for k in MANIFEST_FIELDS:
+            if k in man:
+                out.append(f"  {k:<14} {man.get(k)}")
+    kinds = {}
+    for rec in records:
+        kinds[rec.get("event", "record")] = \
+            kinds.get(rec.get("event", "record"), 0) + 1
+    out.append("  events: " + ", ".join(f"{k}={n}"
+                                        for k, n in sorted(kinds.items())))
+    steps = _step_records(records)
+    if steps:
+        first, last = steps[0], steps[-1]
+        keys = [k for k in ("loss", "epe", "it_per_s") if k in last]
+        out.append(f"  steps {first['step']} -> {last['step']}: " + "  ".join(
+            f"{k} {_fmt_val(first.get(k))} -> {_fmt_val(last.get(k))}"
+            for k in keys))
+    for rec in records:
+        if rec.get("event") == "run_end" and isinstance(rec.get("metrics"),
+                                                        dict):
+            for name, val in sorted(rec["metrics"].items()):
+                out.append(f"  {name:<32} {_fmt_val(val)}")
+        if rec.get("event") == "nonfinite":
+            out.append(f"  NONFINITE at stage {rec.get('stage')!r} "
+                       f"({rec.get('bad_values')} value(s))")
+        if rec.get("event") == "recompile":
+            out.append(f"  RECOMPILE #{rec.get('n')} at stage "
+                       f"{rec.get('stage')!r} ({rec.get('duration_s')}s)")
+    # bench-style single objects: surface the headline numbers
+    for rec in records:
+        if "value" in rec and "metric" in rec:
+            out.append(f"  {rec['metric']}: {rec['value']} "
+                       f"{rec.get('unit', '')}".rstrip())
+    return out
+
+
+def _final_numbers(records: List[dict]) -> dict:
+    """Flat {name: number} view of a run's results, for compare."""
+    out = {}
+    steps = _step_records(records)
+    if steps:
+        for k, v in steps[-1].items():
+            if isinstance(v, (int, float)) and k != "step":
+                out[f"final.{k}"] = v
+        out["final.step"] = steps[-1]["step"]
+    for rec in records:
+        if rec.get("event") == "run_end" and isinstance(rec.get("metrics"),
+                                                        dict):
+            for name, val in rec["metrics"].items():
+                if isinstance(val, (int, float)):
+                    out[name] = val
+                elif isinstance(val, dict):
+                    for sub, sv in val.items():
+                        if isinstance(sv, (int, float)):
+                            out[f"{name}.{sub}"] = sv
+        if "value" in rec and isinstance(rec.get("value"), (int, float)):
+            out["value"] = rec["value"]
+            for k in ("vs_baseline", "mfu"):
+                if isinstance(rec.get(k), (int, float)):
+                    out[k] = rec[k]
+    return out
+
+
+def compare_lines(path_a, path_b) -> Tuple[List[str], bool]:
+    """Returns (report lines, comparable) — comparable is False when either
+    side has no manifest (provenance unknown)."""
+    ra, rb = load_records(path_a), load_records(path_b)
+    ma, mb = manifest_of(ra), manifest_of(rb)
+    out = [f"== compare A={path_a}  B={path_b}"]
+    comparable = ma is not None and mb is not None
+    if not comparable:
+        out.append("  manifest missing on "
+                   + ("both sides" if ma is None and mb is None
+                      else ("A" if ma is None else "B"))
+                   + " — provenance unknown")
+    ma, mb = ma or {}, mb or {}
+    same, diff = [], []
+    for k in MANIFEST_FIELDS:
+        va, vb = ma.get(k), mb.get(k)
+        (same if va == vb else diff).append((k, va, vb))
+    for k, va, vb in diff:
+        out.append(f"  {k:<14} A={va}  B={vb}")
+    if not diff:
+        out.append("  manifests identical on "
+                   + ",".join(k for k, *_ in same))
+    na, nb = _final_numbers(ra), _final_numbers(rb)
+    for k in sorted(set(na) | set(nb)):
+        va, vb = na.get(k), nb.get(k)
+        if va is None or vb is None:
+            out.append(f"  {k:<32} A={_fmt_val(va)}  B={_fmt_val(vb)}")
+        elif va != vb:
+            delta = vb - va
+            pct = f" ({delta / va * 100:+.1f}%)" if va else ""
+            out.append(f"  {k:<32} A={_fmt_val(va)}  B={_fmt_val(vb)}"
+                       f"{pct}")
+        else:
+            out.append(f"  {k:<32} {_fmt_val(va)}  (same)")
+    return out, comparable
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tlm", description="tail/summarize/compare telemetry run logs")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pt = sub.add_parser("tail", help="print the last N records")
+    pt.add_argument("path")
+    pt.add_argument("-n", type=int, default=10)
+    ps = sub.add_parser("summary", help="manifest + event counts + results")
+    ps.add_argument("path")
+    pc = sub.add_parser("compare", help="diff two runs with provenance")
+    pc.add_argument("a")
+    pc.add_argument("b")
+    args = p.parse_args(argv)
+
+    try:
+        if args.cmd == "tail":
+            for rec in load_records(args.path)[-args.n:]:
+                print(json.dumps(rec))
+        elif args.cmd == "summary":
+            print("\n".join(summary_lines(args.path)))
+        else:
+            lines, comparable = compare_lines(args.a, args.b)
+            print("\n".join(lines))
+            return 0 if comparable else 1
+    except FileNotFoundError as e:
+        print(f"tlm: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -123,6 +123,7 @@ def main() -> int:
         forward_interpolate(lr)
     fi_ms = (time.perf_counter() - t0) / reps * 1e3
 
+    from raft_tpu.telemetry import run_manifest
     print(json.dumps({
         "metric": "sintel warm-start eval cost (compile-free: jitted eval "
                   "fns are lru-cached across calls)",
@@ -134,6 +135,7 @@ def main() -> int:
         "warm_pairs_per_s": round(n / warm_s, 3),
         "warm_overhead_pct": round((warm_s - cold_s) / cold_s * 100, 1),
         "forward_interpolate_ms": round(fi_ms, 2),
+        "manifest": run_manifest(config=config, mode="warmstart_bench"),
     }))
     return 0
 
