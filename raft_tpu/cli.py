@@ -192,6 +192,21 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--workers", type=int, default=0,
                    help="decode/augment worker processes (0 = in-line in the "
                         "prefetch thread); the PrefetchDataZMQ analog")
+    p.add_argument("--device-aug", action="store_true",
+                   help="train mode: run the FlowAugmentor recipe ON DEVICE "
+                        "(data/augment_device.py) — workers only decode "
+                        "uint8 frames; photometric/scale/flip/crop/eraser "
+                        "execute as one jitted batched program in the "
+                        "prefetch stage (dense-gt stages only)")
+    p.add_argument("--prefetch-depth", type=int, default=2, metavar="N",
+                   help="train mode: staged device batches buffered ahead "
+                        "of the consumer (PrefetchLoader depth; "
+                        "raft_data_wait_seconds tells you if it is too low)")
+    p.add_argument("--shm-slots", type=int, default=None, metavar="N",
+                   help="train mode, with --workers: shared-memory sample "
+                        "ring size for the zero-copy transport (default "
+                        "2*workers+2; 0 falls back to pickling samples "
+                        "through queues)")
     p.add_argument("--accum", type=int, default=None, metavar="K",
                    help="train mode: split each batch into K sequential "
                         "micro-batches inside the jitted step (gradient "

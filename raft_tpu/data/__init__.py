@@ -1,5 +1,6 @@
 from .augment import FlowAugmentor, PairAugmentor
 from .datasets import (FlowDataset, FlyingChairs, FlyingThings3D, Kitti,
                        MpiSintel, PairList, make_training_dataset)
-from .pipeline import (PrefetchLoader, batch_samples, batched,
-                       pad_to_multiple, synthetic_batches, unpad)
+from .pipeline import (BatchBuffers, PrefetchLoader, batch_samples, batched,
+                       pad_to_multiple, pad_to_shape, synthetic_batches,
+                       unpad)

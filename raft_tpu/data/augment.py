@@ -22,6 +22,17 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+# Official-curriculum scale ranges per training stage (min_scale, max_scale),
+# shared by the host FlowAugmentor wiring (datasets.make_training_dataset)
+# and the device-side reimplementation (augment_device.make_device_augmentor)
+# so the two pipelines draw from the same spatial distribution.
+STAGE_SCALES = {
+    "chairs": (-0.1, 1.0),
+    "things": (-0.4, 0.8),
+    "sintel": (-0.2, 0.6),
+    "synthetic": (-0.2, 0.5),
+}
+
 
 def _apply_contrast(im: np.ndarray, factor: float) -> np.ndarray:
     mean = im.mean()
@@ -244,7 +255,16 @@ class SparseFlowAugmentor:
 
 
 class FlowAugmentor:
-    """Flow-aware training augmentation (official-RAFT-style recipe)."""
+    """Flow-aware training augmentation (official-RAFT-style recipe).
+
+    Split into :meth:`sample_params` (all RandomState draws, in a fixed
+    order) and :meth:`apply_params` (deterministic transform given those
+    draws) so the device-side reimplementation
+    (:mod:`raft_tpu.data.augment_device`) can be parity-tested against this
+    numpy oracle with SHARED sampled parameters.  ``__call__`` composes the
+    two and is draw-for-draw identical to the pre-split behavior, so
+    seed-per-index sample determinism is preserved across the refactor.
+    """
 
     def __init__(self, crop_size: Tuple[int, int], min_scale: float = -0.2,
                  max_scale: float = 0.5, do_flip: bool = True,
@@ -263,13 +283,19 @@ class FlowAugmentor:
         self.photometric = photometric
         self.rng = rng or np.random.RandomState()
 
-    def _spatial(self, im1, im2, flow):
-        import cv2
+    def sample_params(self, h: int, w: int) -> dict:
+        """Draw every random decision for one (h, w) sample, in the exact
+        RandomState call order of the historical ``__call__`` (photometric,
+        scale/stretch, spatial coin, flips, crop origin, eraser) — the order
+        IS the determinism contract for seed-per-index workers."""
         rng = self.rng
         ch, cw = self.crop_size
-        h, w = im1.shape[:2]
+        p = {"crop": (ch, cw)}
+        if self.photometric:
+            p["contrast"] = float(rng.uniform(0.8, 1.2))
+            p["gamma"] = float(rng.uniform(-0.2, 0.2))
+            p["brightness"] = float(rng.uniform(-20, 20))
         min_scale = max((ch + 8) / float(h), (cw + 8) / float(w))
-
         scale = 2.0 ** rng.uniform(self.min_scale, self.max_scale)
         sx = sy = scale
         if rng.rand() < self.stretch_prob:
@@ -277,44 +303,69 @@ class FlowAugmentor:
             sy *= 2.0 ** rng.uniform(-self.max_stretch, self.max_stretch)
         sx = max(sx, min_scale)
         sy = max(sy, min_scale)
-
         if rng.rand() < self.spatial_prob:
-            nw, nh = int(round(w * sx)), int(round(h * sy))
+            p["nh"], p["nw"] = int(round(h * sy)), int(round(w * sx))
+        else:   # no resample: flow keeps its original scale
+            p["nh"], p["nw"] = h, w
+        p["hflip"] = bool(self.do_flip and rng.rand() < 0.5)
+        p["vflip"] = bool(self.do_flip and rng.rand() < 0.1)
+        p["y0"] = int(rng.randint(0, p["nh"] - ch + 1))
+        p["x0"] = int(rng.randint(0, p["nw"] - cw + 1))
+        rects = []
+        if rng.rand() < self.eraser_prob:
+            for _ in range(rng.randint(1, 3)):
+                rects.append((int(rng.randint(0, cw)), int(rng.randint(0, ch)),
+                              int(rng.randint(50, 100)),
+                              int(rng.randint(50, 100))))
+        p["erase_rects"] = rects
+        return p
+
+    def apply_params(self, im1: np.ndarray, im2: np.ndarray, flow: np.ndarray,
+                     p: dict) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                       np.ndarray]:
+        """Deterministic transform for pre-sampled params ``p`` — the numpy
+        oracle the device augmentor is parity-tested against."""
+        import cv2
+        ch, cw = p["crop"]
+        im1 = im1.astype(np.float32)
+        im2 = im2.astype(np.float32)
+        flow = flow.astype(np.float32)
+        h, w = im1.shape[:2]
+        if self.photometric:
+            for f in ((lambda x: _apply_contrast(x, p["contrast"])),
+                      (lambda x: _apply_gamma(x, p["gamma"])),
+                      (lambda x: np.clip(x + p["brightness"], 0, 255))):
+                im1, im2 = f(im1), f(im2)
+        nh, nw = p["nh"], p["nw"]
+        if (nh, nw) != (h, w):
             im1 = cv2.resize(im1, (nw, nh), interpolation=cv2.INTER_LINEAR)
             im2 = cv2.resize(im2, (nw, nh), interpolation=cv2.INTER_LINEAR)
             flow = cv2.resize(flow, (nw, nh), interpolation=cv2.INTER_LINEAR)
             flow = flow * [nw / float(w), nh / float(h)]
-
-        if self.do_flip:
-            if rng.rand() < 0.5:     # horizontal
-                im1 = im1[:, ::-1]
-                im2 = im2[:, ::-1]
-                flow = flow[:, ::-1] * [-1.0, 1.0]
-            if rng.rand() < 0.1:     # vertical
-                im1 = im1[::-1]
-                im2 = im2[::-1]
-                flow = flow[::-1] * [1.0, -1.0]
-
-        y0 = rng.randint(0, im1.shape[0] - ch + 1)
-        x0 = rng.randint(0, im1.shape[1] - cw + 1)
+        if p["hflip"]:
+            im1 = im1[:, ::-1]
+            im2 = im2[:, ::-1]
+            flow = flow[:, ::-1] * [-1.0, 1.0]
+        if p["vflip"]:
+            im1 = im1[::-1]
+            im2 = im2[::-1]
+            flow = flow[::-1] * [1.0, -1.0]
+        y0, x0 = p["y0"], p["x0"]
         im1 = im1[y0:y0 + ch, x0:x0 + cw]
-        im2 = im2[y0:y0 + ch, x0:x0 + cw]
+        im2 = np.ascontiguousarray(im2[y0:y0 + ch, x0:x0 + cw])
         flow = flow[y0:y0 + ch, x0:x0 + cw]
-        return im1, im2, flow
+        if p["erase_rects"]:
+            mean = im2.reshape(-1, 3).mean(0)
+            for ex, ey, dx, dy in p["erase_rects"]:
+                im2[ey:ey + dy, ex:ex + dx] = mean
+        im1 = np.ascontiguousarray(im1) / 255.0
+        im2 = im2 / 255.0
+        flow = np.ascontiguousarray(flow)
+        valid = (np.abs(flow[..., 0]) < 1000) & (np.abs(flow[..., 1]) < 1000)
+        return im1, im2, flow, valid.astype(np.float32)
 
     def __call__(self, im1: np.ndarray, im2: np.ndarray, flow: np.ndarray
                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """uint8 images + [H,W,2] flow -> cropped float [0,1] pair, flow, valid."""
-        im1 = im1.astype(np.float32)
-        im2 = im2.astype(np.float32)
-        flow = flow.astype(np.float32)
-        if self.photometric:
-            im1, im2 = _paired_color(self.rng, im1, im2)
-        im1, im2, flow = self._spatial(im1, im2, flow)
-        im2 = _occlusion_eraser(self.rng, np.ascontiguousarray(im2),
-                                self.eraser_prob)
-        im1 = np.ascontiguousarray(im1) / 255.0
-        im2 = np.ascontiguousarray(im2) / 255.0
-        flow = np.ascontiguousarray(flow)
-        valid = (np.abs(flow[..., 0]) < 1000) & (np.abs(flow[..., 1]) < 1000)
-        return im1, im2, flow, valid.astype(np.float32)
+        h, w = im1.shape[:2]
+        return self.apply_params(im1, im2, flow, self.sample_params(h, w))

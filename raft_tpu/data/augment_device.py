@@ -1,0 +1,351 @@
+"""Device-side flow augmentation: the FlowAugmentor recipe on the accelerator.
+
+PERF.md round 7: the host decode+augment path delivers a few pairs/s per
+core while one chip consumes an order of magnitude more — and the augment
+math (photometric LUTs, cv2 resizes, crops) is the GIL-bound majority of
+that per-sample budget on real datasets.  This module re-implements
+:class:`raft_tpu.data.augment.FlowAugmentor` as a jitted, batched,
+PRNG-keyed JAX program so worker processes only *decode* (uint8 frames +
+float flow) and the augmentation runs on-device, overlapped with training
+via :class:`raft_tpu.data.pipeline.PrefetchLoader`'s staging thread.
+
+Numerical contract: given the SAME sampled parameters, :meth:`apply_params`
+matches the numpy augmentor's :meth:`~raft_tpu.data.augment.FlowAugmentor.
+apply_params` to float32 round-off (tests/test_data.py parity suite):
+
+* photometric — contrast about the full-frame mean, the gamma LUT's
+  floor-index semantics (``lut[uint8(x)]``), brightness clip; identical
+  draw applied to both frames;
+* spatial — scale/stretch resize + flip + crop folded into ONE inverse
+  bilinear gather using cv2.resize's INTER_LINEAR coordinate convention
+  ``src = (dst + 0.5) * (size_src / size_resized) - 0.5`` with replicate
+  clamping, so the data-dependent intermediate (nh, nw) never materializes
+  (jit needs static shapes; the gather output is always the crop);
+* flow values scale by the SAME rounded ``(nw/w, nh/h)`` factors and flip
+  signs exactly as the host augmentor;
+* occlusion eraser — mean-color rectangles on frame 2, mean taken before
+  any rectangle is painted.
+
+Sampling (:meth:`sample_params`) is keyed by ``jax.random`` — per-sample
+keys derive from (loader seed, batch index, row), giving the device path
+its own deterministic stream.  Draw *distributions* match the host
+augmentor; the underlying generator differs by design (threefry vs
+MT19937), so host and device pipelines are each reproducible but not
+cross-identical.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .augment import STAGE_SCALES
+from .datasets import FlowDataset
+
+
+class AugParams(NamedTuple):
+    """Per-sample augmentation draws — a pytree so it crosses jit/vmap.
+
+    ``contrast=1, gamma=0, brightness=0`` encode "photometric off";
+    ``erase_count=0`` encodes "eraser off"; ``nh == h, nw == w`` encodes
+    "no resample" (the gather degenerates to an exact integer-coordinate
+    crop, and the flow scale factors become 1)."""
+
+    contrast: jnp.ndarray      # f32 []
+    gamma: jnp.ndarray         # f32 []
+    brightness: jnp.ndarray    # f32 []
+    nh: jnp.ndarray            # i32 [] resized height
+    nw: jnp.ndarray            # i32 [] resized width
+    hflip: jnp.ndarray         # bool []
+    vflip: jnp.ndarray         # bool []
+    y0: jnp.ndarray            # i32 [] crop origin (resized coords)
+    x0: jnp.ndarray            # i32 []
+    erase_count: jnp.ndarray   # i32 [] 0..2 rectangles
+    erase_rects: jnp.ndarray   # i32 [2, 4] (x0, y0, dx, dy)
+
+
+def params_from_host(p: dict) -> AugParams:
+    """Lift a FlowAugmentor.sample_params dict into device AugParams — the
+    bridge the shared-parameter parity tests drive both pipelines through."""
+    rects = np.zeros((2, 4), np.int32)
+    n = len(p["erase_rects"])
+    for i, r in enumerate(p["erase_rects"]):
+        rects[i] = r
+    return AugParams(
+        contrast=jnp.float32(p.get("contrast", 1.0)),
+        gamma=jnp.float32(p.get("gamma", 0.0)),
+        brightness=jnp.float32(p.get("brightness", 0.0)),
+        nh=jnp.int32(p["nh"]), nw=jnp.int32(p["nw"]),
+        hflip=jnp.bool_(p["hflip"]), vflip=jnp.bool_(p["vflip"]),
+        y0=jnp.int32(p["y0"]), x0=jnp.int32(p["x0"]),
+        erase_count=jnp.int32(n), erase_rects=jnp.asarray(rects))
+
+
+class DeviceFlowAugmentor:
+    """FlowAugmentor's hyperparameters, executed as a JAX program.
+
+    All methods are per-sample and trace-safe; batch them with ``jax.vmap``
+    (or use :func:`make_batch_augment_fn`, which also jits and splits keys).
+    """
+
+    def __init__(self, crop_size: Tuple[int, int], min_scale: float = -0.2,
+                 max_scale: float = 0.5, do_flip: bool = True,
+                 spatial_prob: float = 0.8, stretch_prob: float = 0.8,
+                 max_stretch: float = 0.2, eraser_prob: float = 0.5,
+                 photometric: bool = True):
+        self.crop_size = tuple(crop_size)
+        self.min_scale = float(min_scale)
+        self.max_scale = float(max_scale)
+        self.do_flip = bool(do_flip)
+        self.spatial_prob = float(spatial_prob)
+        self.stretch_prob = float(stretch_prob)
+        self.max_stretch = float(max_stretch)
+        self.eraser_prob = float(eraser_prob)
+        self.photometric = bool(photometric)
+
+    # ------------------------------------------------------------- sampling
+
+    def sample_params(self, key: jax.Array, hw: jax.Array) -> AugParams:
+        """Draw one sample's params from ``key``; ``hw`` is the (h, w)
+        content extent (i32 [2], may be traced)."""
+        ch, cw = self.crop_size
+        h = hw[0].astype(jnp.float32)
+        w = hw[1].astype(jnp.float32)
+        ks = jax.random.split(key, 18)
+        one = jnp.float32(1.0)
+        if self.photometric:
+            contrast = jax.random.uniform(ks[0], (), minval=0.8, maxval=1.2)
+            gamma = jax.random.uniform(ks[1], (), minval=-0.2, maxval=0.2)
+            brightness = jax.random.uniform(ks[2], (), minval=-20.0,
+                                            maxval=20.0)
+        else:
+            contrast, gamma, brightness = one, one * 0, one * 0
+        floor = jnp.maximum((ch + 8) / h, (cw + 8) / w)
+        scale = 2.0 ** jax.random.uniform(ks[3], (), minval=self.min_scale,
+                                          maxval=self.max_scale)
+        stretch = jax.random.bernoulli(ks[4], self.stretch_prob)
+        st_x = 2.0 ** jax.random.uniform(ks[5], (), minval=-self.max_stretch,
+                                         maxval=self.max_stretch)
+        st_y = 2.0 ** jax.random.uniform(ks[6], (), minval=-self.max_stretch,
+                                         maxval=self.max_stretch)
+        sx = jnp.maximum(scale * jnp.where(stretch, st_x, 1.0), floor)
+        sy = jnp.maximum(scale * jnp.where(stretch, st_y, 1.0), floor)
+        spatial = jax.random.bernoulli(ks[7], self.spatial_prob)
+        nh = jnp.where(spatial, jnp.round(h * sy), h).astype(jnp.int32)
+        nw = jnp.where(spatial, jnp.round(w * sx), w).astype(jnp.int32)
+        hflip = jnp.logical_and(self.do_flip,
+                                jax.random.bernoulli(ks[8], 0.5))
+        vflip = jnp.logical_and(self.do_flip,
+                                jax.random.bernoulli(ks[9], 0.1))
+        y0 = jax.random.randint(ks[10], (), 0, nh - ch + 1)
+        x0 = jax.random.randint(ks[11], (), 0, nw - cw + 1)
+        erase_on = jax.random.bernoulli(ks[12], self.eraser_prob)
+        n_rects = jax.random.randint(ks[13], (), 1, 3)
+        rects = jnp.stack([
+            jax.random.randint(ks[14], (2,), 0, cw),
+            jax.random.randint(ks[15], (2,), 0, ch),
+            jax.random.randint(ks[16], (2,), 50, 100),
+            jax.random.randint(ks[17], (2,), 50, 100)], axis=-1)
+        return AugParams(contrast=contrast, gamma=gamma,
+                         brightness=brightness, nh=nh, nw=nw,
+                         hflip=hflip, vflip=vflip, y0=y0, x0=x0,
+                         erase_count=jnp.where(erase_on, n_rects, 0),
+                         erase_rects=rects)
+
+    # ---------------------------------------------------------- application
+
+    def _photometric(self, im: jnp.ndarray, p: AugParams,
+                     mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+        # contrast about the full-frame mean (host: im.mean() over H*W*C),
+        # masked to the content extent when the frame is canonically padded
+        if mask is None:
+            mean = jnp.mean(im)
+        else:
+            mean = (jnp.sum(im * mask)
+                    / jnp.maximum(jnp.sum(mask) * im.shape[-1], 1.0))
+        im = jnp.clip((im - mean) * p.contrast + mean, 0.0, 255.0)
+        # gamma: the host LUT indexes by uint8(x), i.e. floor for x in
+        # [0, 255] — reproduce the quantization, then the power curve
+        idx = jnp.clip(jnp.floor(im), 0.0, 255.0) / 255.0
+        im = jnp.power(idx, 1.0 + p.gamma) * 255.0
+        return jnp.clip(im + p.brightness, 0.0, 255.0)
+
+    @staticmethod
+    def _src_coords(r: jnp.ndarray, size: jnp.ndarray, nsize: jnp.ndarray
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Exact-rational inverse resize coordinates: integer resized-frame
+        coordinate ``r`` maps to source ``(r + 0.5) * size/nsize - 0.5 =
+        ((2r + 1) size - nsize) / (2 nsize)``.  Computing floor and
+        remainder on the integer numerator keeps the tap indices EXACT and
+        the lerp weight accurate to one f32 ulp — f32 coordinate products
+        would drift by ~1e-5 px and bleed into the parity budget."""
+        num = (2 * r + 1) * size - nsize
+        den = 2 * nsize
+        lo = num // den
+        frac = (num - lo * den).astype(jnp.float32) / den.astype(jnp.float32)
+        return lo, frac
+
+    def _gather(self, im: jnp.ndarray, yr: jnp.ndarray, xr: jnp.ndarray,
+                h: jnp.ndarray, nh: jnp.ndarray, w: jnp.ndarray,
+                nw: jnp.ndarray) -> jnp.ndarray:
+        """Bilinear sample ``im[H, W, C]`` at the outer product of integer
+        resized-frame coordinates ``yr [ch], xr [cw]`` (cv2 INTER_LINEAR
+        semantics: horizontal lerp first, replicate border via index
+        clamping to the (h, w) content extent — canonical padding is never
+        sampled)."""
+        y0, wy = self._src_coords(yr, h, nh)
+        x0, wx = self._src_coords(xr, w, nw)
+        wy = wy[:, None, None]
+        wx = wx[None, :, None]
+        y0i = jnp.clip(y0, 0, h - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x0i = jnp.clip(x0, 0, w - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+
+        def rows(yi):
+            top = im[yi[:, None], x0i[None, :]]
+            bot = im[yi[:, None], x1i[None, :]]
+            return top * (1.0 - wx) + bot * wx
+
+        return rows(y0i) * (1.0 - wy) + rows(y1i) * wy
+
+    def apply_params(self, p: AugParams, im1: jnp.ndarray, im2: jnp.ndarray,
+                     flow: jnp.ndarray, hw: Optional[jax.Array] = None
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                                jnp.ndarray]:
+        """One sample: [H, W, 3] frames (uint8 or float, 0..255 scale) +
+        [H, W, 2] flow -> crop-shaped float [0,1] pair, flow, valid."""
+        ch, cw = self.crop_size
+        H, W = im1.shape[0], im1.shape[1]
+        if hw is None:
+            hw = jnp.array([H, W], jnp.int32)
+        h, w = hw[0], hw[1]
+        im1 = im1.astype(jnp.float32)
+        im2 = im2.astype(jnp.float32)
+        flow = flow.astype(jnp.float32)
+        if self.photometric:
+            ys_f = jnp.arange(H)[:, None]
+            xs_f = jnp.arange(W)[None, :]
+            mask = ((ys_f < h) & (xs_f < w)).astype(jnp.float32)[..., None]
+            im1 = self._photometric(im1, p, mask)
+            im2 = self._photometric(im2, p, mask)
+
+        # crop coords in the (virtual) resized frame; host flips the resized
+        # arrays BEFORE cropping, so mirror the integer coordinates first
+        yr = p.y0 + jnp.arange(ch, dtype=jnp.int32)
+        xr = p.x0 + jnp.arange(cw, dtype=jnp.int32)
+        yr = jnp.where(p.vflip, p.nh - 1 - yr, yr)
+        xr = jnp.where(p.hflip, p.nw - 1 - xr, xr)
+        im1c = self._gather(im1, yr, xr, h, p.nh, w, p.nw)
+        im2c = self._gather(im2, yr, xr, h, p.nh, w, p.nw)
+        flowc = self._gather(flow, yr, xr, h, p.nh, w, p.nw)
+        fx = (p.nw.astype(jnp.float32) / w.astype(jnp.float32)
+              * jnp.where(p.hflip, -1.0, 1.0))
+        fy = (p.nh.astype(jnp.float32) / h.astype(jnp.float32)
+              * jnp.where(p.vflip, -1.0, 1.0))
+        flowc = flowc * jnp.stack([fx, fy])
+
+        # occlusion eraser on frame 2: mean BEFORE any rect is painted
+        mean = jnp.mean(im2c.reshape(-1, 3), axis=0)
+        yg = jnp.arange(ch)[:, None]
+        xg = jnp.arange(cw)[None, :]
+        for r in range(2):
+            ex, ey, dx, dy = (p.erase_rects[r, 0], p.erase_rects[r, 1],
+                              p.erase_rects[r, 2], p.erase_rects[r, 3])
+            hit = ((r < p.erase_count) & (xg >= ex) & (xg < ex + dx)
+                   & (yg >= ey) & (yg < ey + dy))
+            im2c = jnp.where(hit[..., None], mean, im2c)
+
+        valid = ((jnp.abs(flowc[..., 0]) < 1000)
+                 & (jnp.abs(flowc[..., 1]) < 1000))
+        return (im1c / 255.0, im2c / 255.0, flowc,
+                valid.astype(jnp.float32))
+
+    def __call__(self, key: jax.Array, im1, im2, flow,
+                 hw: Optional[jax.Array] = None):
+        return self.apply_params(self.sample_params(
+            key, jnp.asarray(im1.shape[:2], jnp.int32) if hw is None else hw),
+            im1, im2, flow, hw)
+
+
+def make_batch_augment_fn(aug: DeviceFlowAugmentor,
+                          hw: Optional[Tuple[int, int]] = None):
+    """Jitted batched entry: ``fn(key, im1, im2, flow) -> (im1, im2, flow,
+    valid)`` with per-row keys split from ``key``.  ``hw`` fixes the content
+    extent for every row (the uniform-frame-size datasets); None means the
+    full canonical shape is content."""
+
+    def fn(key, im1, im2, flow):
+        b = im1.shape[0]
+        extent = jnp.broadcast_to(
+            jnp.asarray(hw if hw is not None else im1.shape[1:3], jnp.int32),
+            (b, 2))
+        keys = jax.random.split(key, b)
+
+        def one(k, a, bb, f, e):
+            return aug.apply_params(aug.sample_params(k, e), a, bb, f, e)
+
+        return jax.vmap(one)(keys, im1, im2, flow, extent)
+
+    return jax.jit(fn)
+
+
+class DecodeOnlyDataset:
+    """Decode-only view for the device-augmented pipeline: ``__getitem__``
+    runs the underlying dataset's raw ``_load`` (uint8 frames + float flow,
+    no host augmentor, no /255 float conversion) so worker processes ship
+    the cheapest possible sample and all augment math runs on-device.
+    Samples are (im1, im2, flow) 3-tuples — the device augmentor derives
+    the validity mask itself, so shipping a host-built one would be a
+    wasted H*W float plane per sample.
+
+    Frames must share one canonical (H, W) — true of every dense training
+    stage (chairs/things/sintel/synthetic); a mismatched frame raises
+    rather than silently corrupting the fixed-shape transport slot.
+    Sparse ground truth (a non-None ``valid`` from ``_load``) is host-only
+    and raises."""
+
+    augmentor = None
+
+    def __init__(self, ds, canonical_hw: Optional[Tuple[int, int]] = None):
+        self.ds = ds
+        if canonical_hw is None:
+            probe = ds._load(0)
+            canonical_hw = tuple(probe[0].shape[:2])
+        self.canonical_hw = tuple(canonical_hw)
+
+    def __len__(self) -> int:
+        return len(self.ds)
+
+    def __getitem__(self, idx):
+        im1, im2, flow, valid = self.ds._load(idx)
+        if valid is not None:
+            raise ValueError(
+                "device-side augmentation needs dense ground truth "
+                "(sparse/gt-less splits keep the host pipeline)")
+        h, w = im1.shape[:2]
+        if (h, w) != self.canonical_hw:
+            raise ValueError(
+                f"device-aug needs uniform source frames: sample {idx} is "
+                f"({h}, {w}), canonical is {self.canonical_hw}")
+        return (np.ascontiguousarray(im1, dtype=np.uint8),
+                np.ascontiguousarray(im2, dtype=np.uint8),
+                np.ascontiguousarray(flow, dtype=np.float32))
+
+    # same shuffle/epoch semantics as FlowDataset, over the decode-only view
+    # (the ShardedDataset alias pattern — one implementation to drift)
+    sample_iter = FlowDataset.sample_iter
+
+
+def make_device_augmentor(stage: str,
+                          crop_size: Tuple[int, int]) -> DeviceFlowAugmentor:
+    """Stage-preset device augmentor sharing the host pipeline's
+    :data:`~raft_tpu.data.augment.STAGE_SCALES` ranges."""
+    if stage not in STAGE_SCALES:
+        raise ValueError(f"device-side augmentation has no preset for "
+                         f"{stage!r} (sparse-gt stages are host-only)")
+    lo, hi = STAGE_SCALES[stage]
+    return DeviceFlowAugmentor(crop_size, min_scale=lo, max_scale=hi)

@@ -15,7 +15,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..utils.flow_io import read_flo, read_kitti_flow, read_pfm
-from .augment import FlowAugmentor, PairAugmentor
+from .augment import STAGE_SCALES, FlowAugmentor, PairAugmentor
 
 
 _PNG_MAGIC = b"\x89PNG"
@@ -279,23 +279,32 @@ class PairList:
             yield self.processor(_read_image(a), _read_image(b))
 
 
-def make_training_dataset(stage: str, root: str,
-                          crop_size: Tuple[int, int]) -> FlowDataset:
+def make_training_dataset(stage: str, root: str, crop_size: Tuple[int, int],
+                          device_aug: bool = False) -> FlowDataset:
     """Stage presets following the official curriculum: chairs -> things ->
-    sintel/kitti finetune; 'synthetic' needs no root (procedural data)."""
+    sintel/kitti finetune; 'synthetic' needs no root (procedural data).
+
+    ``device_aug=True`` attaches NO host augmentor — the caller wraps the
+    dataset in :class:`raft_tpu.data.augment_device.DecodeOnlyDataset` and
+    runs the same-recipe augmentation on the accelerator
+    (``augment_device.make_device_augmentor`` shares :data:`STAGE_SCALES`)."""
     if stage == "synthetic":
         from .synthetic import SyntheticFlowDataset
         return SyntheticFlowDataset(size=crop_size)
-    if stage == "chairs":
-        aug = FlowAugmentor(crop_size, min_scale=-0.1, max_scale=1.0)
-        return FlyingChairs(root, "training", aug)
-    if stage == "things":
-        aug = FlowAugmentor(crop_size, min_scale=-0.4, max_scale=0.8)
-        return FlyingThings3D(root, augmentor=aug)
-    if stage == "sintel":
-        aug = FlowAugmentor(crop_size, min_scale=-0.2, max_scale=0.6)
-        return MpiSintel(root, "training", "clean", aug)
     if stage == "kitti":
+        if device_aug:
+            raise ValueError("device-side augmentation does not support "
+                             "sparse ground truth (kitti) — its valid-aware "
+                             "scatter resample is host-only; drop --device-aug")
         from .augment import SparseFlowAugmentor
         return Kitti(root, "training", augmentor=SparseFlowAugmentor(crop_size))
-    raise ValueError(stage)
+    if stage not in STAGE_SCALES:
+        raise ValueError(stage)
+    lo, hi = STAGE_SCALES[stage]
+    aug = None if device_aug else FlowAugmentor(crop_size, min_scale=lo,
+                                                max_scale=hi)
+    if stage == "chairs":
+        return FlyingChairs(root, "training", aug)
+    if stage == "things":
+        return FlyingThings3D(root, augmentor=aug)
+    return MpiSintel(root, "training", "clean", aug)

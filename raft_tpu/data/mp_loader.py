@@ -5,8 +5,23 @@ GIL-bound numpy/cv2 work; a single pump thread tops out well below a TPU
 step rate at training shapes.  This is the tensorpack-PrefetchDataZMQ analog
 (reference dataflow/test_dataflow.py:7, imported there but never used):
 worker *processes* each run ``dataset[idx]`` and stream finished samples back
-over bounded queues, so augmentation scales across cores while the batching /
-device staging stays in the main process (pipeline.PrefetchLoader).
+to the main process, so decode/augment scales across cores while the
+batching / device staging stays in the main process (pipeline.PrefetchLoader).
+
+Two transports:
+
+* ``transport='pickle'`` — samples are pickled through the bounded result
+  queue (the original path).  Simple, but every multi-MB sample pays
+  serialize + pipe + deserialize.
+* ``transport='shm'`` — workers write sample arrays into a ring of
+  ``multiprocessing.shared_memory`` slots (:class:`ShmRing`; layout pinned
+  by :class:`SampleSpec`) and send only the slot id through the result
+  queue; the main process wraps the slot as zero-copy numpy views.  Slots
+  recycle through a free-list queue: a worker takes a free slot *before*
+  decoding (backpressure), the consumer returns the previous slot each
+  iteration.  **Yielded arrays are views valid only until the next
+  iteration** — collate them copy-on-arrival (``pipeline.batched`` with a
+  ``BatchBuffers`` collator does) or copy explicitly.
 
 Design notes:
 * start method is a knob, default "forkserver": the loader always runs
@@ -26,7 +41,9 @@ Design notes:
   seed, epoch, index) and reseeds the augmentor's RandomState before the
   item is produced, so sample *content* is reproducible even though arrival
   *order* depends on worker scheduling.  (Training consumes a shuffled
-  stream, so order nondeterminism is harmless.)
+  stream, so order nondeterminism is harmless.)  The shm transport changes
+  only WHERE bytes land, never what is computed — determinism tests cover
+  both transports.
 * bounded task/result queues — backpressure instead of unbounded buffering
   (multiprocessing.Pool.imap would eagerly drain the infinite index stream).
 """
@@ -39,37 +56,155 @@ import queue
 import threading
 import time
 import traceback
-from typing import Iterator, Optional
+from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..telemetry.registry import default_registry
 
 _SENTINEL = None
+_SLOT_ALIGN = 64
 
 
 def _loader_metrics():
-    """Counters on the process-default telemetry registry, shared across
-    loader instances (atomic get-or-create: two loaders iterated from
-    different threads must not race into a duplicate-metric error)."""
+    """Counters/gauges on the process-default telemetry registry, shared
+    across loader instances (atomic get-or-create: two loaders iterated
+    from different threads must not race into a duplicate-metric error)."""
     reg = default_registry()
     return {
         "samples": reg.get_or_counter(
             "raft_data_samples_total",
             "Samples delivered by worker-process loaders"),
         "errors": reg.get_or_counter(
-            "raft_data_worker_errors_total",
-            "Worker failures (exception, silent death, stall)"),
+            "raft_data_errors_total",
+            "Data loader failures (worker exception, silent death, stall)"),
+        "free_slots": reg.get_or_gauge(
+            "raft_data_shm_free_slots",
+            "Shared-memory transport: slots currently on the free list"),
     }
 
 
-def _worker_loop(dataset, tasks, results):
+class SampleSpec:
+    """Fixed byte layout of one sample inside a shared-memory slot: an
+    ordered list of (shape, dtype) fields at 64-byte-aligned offsets.
+
+    The layout is the transport contract — every sample a dataset produces
+    must match it exactly (uniform-shape datasets; a mismatch in a worker
+    surfaces as a worker error, not silent corruption)."""
+
+    def __init__(self, fields: Sequence[Tuple[Tuple[int, ...], np.dtype]]):
+        self.fields = tuple((tuple(int(d) for d in shape), np.dtype(dt))
+                            for shape, dt in fields)
+        offsets = []
+        off = 0
+        for shape, dt in self.fields:
+            off = -(-off // _SLOT_ALIGN) * _SLOT_ALIGN
+            offsets.append(off)
+            off += int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        self.offsets = tuple(offsets)
+        self.nbytes = off
+
+    @classmethod
+    def from_sample(cls, sample) -> "SampleSpec":
+        fields = []
+        for f in sample:
+            arr = np.asarray(f)
+            fields.append((arr.shape, arr.dtype))
+        return cls(fields)
+
+    def views(self, buf) -> Tuple[np.ndarray, ...]:
+        """Zero-copy numpy views of every field over a slot's buffer."""
+        return tuple(np.ndarray(shape, dtype=dt, buffer=buf, offset=off)
+                     for (shape, dt), off in zip(self.fields, self.offsets))
+
+    def write(self, buf, sample) -> None:
+        views = self.views(buf)
+        if len(sample) != len(views):
+            raise ValueError(f"sample has {len(sample)} fields, "
+                             f"slot layout has {len(views)}")
+        for dst, src in zip(views, sample):
+            # exact-shape only: numpy broadcasting would let a (H, W, 1) or
+            # (1, W, C) mis-shaped frame fill the slot 'successfully' —
+            # silent corruption instead of the promised worker error
+            if np.shape(src) != dst.shape:
+                raise ValueError(f"sample field shape {np.shape(src)} != "
+                                 f"slot field shape {dst.shape}")
+            dst[...] = src
+
+
+class ShmRing:
+    """Owner side of the slot ring: creates ``slots`` shared-memory blocks
+    of ``nbytes``.  Workers attach by name.
+
+    Teardown is two-phase.  :meth:`unlink` removes the names but KEEPS the
+    owner's mappings valid — the safe default when numpy views of the slots
+    may still be live in another thread (touching a view after the segment
+    is unmapped is a SIGSEGV, not an exception); the pages fall back to the
+    kernel when the process exits.  :meth:`close` additionally unmaps, for
+    owners that control every view's lifetime (e.g. loader_bench's local
+    ring)."""
+
+    def __init__(self, slots: int, nbytes: int):
+        from multiprocessing import shared_memory
+        self.shms = []
+        self._unlinked = False
+        try:
+            for _ in range(slots):
+                self.shms.append(
+                    shared_memory.SharedMemory(create=True, size=nbytes))
+        except BaseException:
+            self.close()
+            raise
+        self.names = tuple(s.name for s in self.shms)
+
+    def views(self, spec: SampleSpec, slot: int) -> Tuple[np.ndarray, ...]:
+        return spec.views(self.shms[slot].buf)
+
+    def unlink(self) -> None:
+        """Remove the segment names; existing mappings (and views over
+        them) stay valid until the process exits."""
+        if self._unlinked:
+            return
+        self._unlinked = True
+        for s in self.shms:
+            try:
+                s.unlink()
+            except (FileNotFoundError, OSError):
+                pass
+
+    def close(self) -> None:
+        """Unlink AND unmap — only when no views can still be live."""
+        self.unlink()
+        for s in self.shms:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.shms = []
+
+
+def _attach_slots(names):
+    """Worker-side attach.  The attach re-registers each segment with the
+    resource tracker, but workers inherit the OWNER's tracker process
+    (forkserver/spawn pass its fd down), where registration is a set-add —
+    idempotent — and the owner's ``unlink()`` unregisters exactly once.  Do
+    NOT ``resource_tracker.unregister`` here: with a shared tracker that
+    would cancel the owner's registration and crash-leak on unlink."""
+    from multiprocessing import shared_memory
+    return [shared_memory.SharedMemory(name=name) for name in names]
+
+
+def _worker_loop(dataset, tasks, results, shm=None):
     # cold-start beacon: spawn + dataset unpickling can take seconds, and
     # the first sample additionally pays the first heavy decode — without a
     # readiness signal all of that counts against the consumer's FIRST
     # stall window, false-positiving short stall_timeouts (ADVICE r3).
     # The consumer treats this as progress, not a sample.
     results.put(("ready", None))
+    slots = spec = free = None
+    if shm is not None:
+        names, spec, free = shm
+        slots = _attach_slots(names)
     while True:
         task = tasks.get()
         if task is _SENTINEL:
@@ -79,7 +214,14 @@ def _worker_loop(dataset, tasks, results):
             aug = getattr(dataset, "augmentor", None)
             if aug is not None and hasattr(aug, "rng"):
                 aug.rng = np.random.RandomState(sample_seed)
-            results.put(("ok", dataset[idx]))
+            if shm is None:
+                results.put(("ok", dataset[idx]))
+            else:
+                # take the free slot BEFORE decoding: backpressure lands on
+                # the cheap wait, not on a finished sample with nowhere to go
+                slot = free.get()
+                spec.write(slots[slot].buf, dataset[idx])
+                results.put(("ok", slot))
         except BaseException:
             results.put(("error", traceback.format_exc()))
             break
@@ -87,28 +229,56 @@ def _worker_loop(dataset, tasks, results):
 
 class MPSampleLoader:
     """Iterator of (im1, im2, flow, valid) samples produced by worker
-    processes; feed it to pipeline.batched + PrefetchLoader."""
+    processes; feed it to pipeline.batched + PrefetchLoader.
+
+    ``transport='shm'`` streams samples through a shared-memory slot ring
+    (zero-copy on the consumer side; see module docstring for the
+    view-lifetime contract).  ``shm_slots`` sizes the ring (default
+    ``2 * num_workers + 2``); ``sample_spec`` pins the layout explicitly,
+    otherwise ``dataset[0]`` is probed once."""
 
     def __init__(self, dataset, num_workers: int = 4, seed: int = 0,
                  shuffle: bool = True, epochs: Optional[int] = None,
                  queue_depth: Optional[int] = None,
                  poll_timeout: float = 10.0,
                  stall_timeout: Optional[float] = 300.0,
-                 start_method: str = "forkserver"):
+                 start_method: str = "forkserver",
+                 transport: str = "pickle",
+                 shm_slots: Optional[int] = None,
+                 sample_spec: Optional[SampleSpec] = None):
         assert num_workers >= 1
         if start_method not in ("fork", "forkserver", "spawn"):
             raise ValueError(f"start_method must be fork/forkserver/spawn, "
                              f"got {start_method!r}")
+        if transport not in ("pickle", "shm"):
+            raise ValueError(f"transport must be pickle/shm, got {transport!r}")
         self._poll_timeout = poll_timeout
         self._stall_timeout = stall_timeout
         self._start_method = start_method
+        self._transport = transport
         ctx = mp.get_context(start_method)
         depth = queue_depth or 2 * num_workers
         self._tasks = ctx.Queue(maxsize=depth)
         self._results = ctx.Queue(maxsize=depth)
+        self._ring = None
+        self._free = None
+        self._spec = None
+        shm_args = None
+        if transport == "shm":
+            self._spec = sample_spec or SampleSpec.from_sample(dataset[0])
+            n_slots = shm_slots if shm_slots is not None \
+                else 2 * num_workers + 2
+            if n_slots < 2:
+                raise ValueError(f"shm transport needs >= 2 slots "
+                                 f"(1 pending + 1 circulating), got {n_slots}")
+            self._ring = ShmRing(n_slots, self._spec.nbytes)
+            self._free = ctx.Queue()
+            for i in range(n_slots):
+                self._free.put(i)
+            shm_args = (self._ring.names, self._spec, self._free)
         self._workers = [
             ctx.Process(target=_worker_loop,
-                        args=(dataset, self._tasks, self._results),
+                        args=(dataset, self._tasks, self._results, shm_args),
                         daemon=True)
             for _ in range(num_workers)]
         for w in self._workers:
@@ -141,6 +311,7 @@ class MPSampleLoader:
         served = 0
         metrics = _loader_metrics()
         last_progress = time.monotonic()
+        pending_slot = None
         while self._n_tasks is None or served < self._n_tasks:
             while True:
                 try:
@@ -185,7 +356,18 @@ class MPSampleLoader:
                 raise RuntimeError(f"data worker failed:\n{payload}")
             served += 1
             metrics["samples"].inc()
-            yield payload
+            if self._transport == "shm":
+                # the consumer has moved past the previous sample (the
+                # copy-on-arrival contract): its slot goes back on the ring
+                if pending_slot is not None:
+                    self._free.put(pending_slot)
+                pending_slot = payload
+                metrics["free_slots"].set(self._free.qsize())
+                yield self._ring.views(self._spec, payload)
+            else:
+                yield payload
+        if pending_slot is not None:
+            self._free.put(pending_slot)
         self.close()
 
     def close(self):
@@ -208,6 +390,13 @@ class MPSampleLoader:
             w.terminate()
         for w in self._workers:
             w.join(timeout=5)
+        if self._ring is not None:
+            # unlink ONLY (names gone; mappings stay valid): close() can be
+            # invoked while another thread — e.g. a PrefetchLoader pump
+            # parked inside this iterator's results.get — still holds slot
+            # views; unmapping under it would SIGSEGV the process.  The
+            # pages return to the kernel at process exit.
+            self._ring.unlink()
 
 
 def measure_rate(sample_iter, n: int, warmup: int = 2) -> float:

@@ -20,6 +20,7 @@ sample, so an eval split is just a different seed.
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Optional, Tuple
 
 import numpy as np
@@ -27,21 +28,42 @@ import numpy as np
 from .datasets import FlowDataset
 
 
+@lru_cache(maxsize=8)
+def _pixel_grid(h: int, w: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Cached read-only (ys, xs) f32 meshgrid — rebuilt per sample it costs
+    a few ms at training shapes, and every sample of a dataset shares it."""
+    ys, xs = np.mgrid[0:h, 0:w].astype(np.float32)
+    ys.setflags(write=False)
+    xs.setflags(write=False)
+    return ys, xs
+
+
 def _noise_texture(rng: np.random.RandomState, h: int, w: int) -> np.ndarray:
     """Multi-octave color noise: structure at several scales so local windows
     are discriminative for matching (pure white noise would alias under the
-    /8 feature encoder)."""
+    /8 feature encoder).
+
+    Perf (PERF.md round 7): this was the dominant cost of the procedural
+    "decode" (five INTER_CUBIC full-resolution upsamples).  The pyramid
+    formulation accumulates coarse-to-fine at octave resolution — every
+    resize except the final one runs at <= 1/4 scale — for the same
+    per-octave amplitudes and the same finest-octave detail, keeping the
+    stand-in honest against real PNG decode times."""
     import cv2
-    canvas = np.zeros((h, w, 3), np.float32)
-    amp, total = 1.0, 0.0
-    for octave in (4, 8, 16, 32, 64):
+    octaves = (4, 8, 16, 32, 64)          # finest -> coarsest grid divisor
+    amps = [0.6 ** k for k in range(len(octaves))]
+    canvas = None
+    for octave, amp in zip(reversed(octaves), reversed(amps)):
         gh, gw = max(h // octave, 2), max(w // octave, 2)
-        grid = rng.rand(gh, gw, 3).astype(np.float32)
-        canvas += amp * cv2.resize(grid, (w, h), interpolation=cv2.INTER_CUBIC)
-        total += amp
-        amp *= 0.6
-    canvas /= total
-    return np.clip(canvas * 255.0, 0, 255).astype(np.uint8)
+        layer = rng.rand(gh, gw, 3).astype(np.float32) * amp
+        if canvas is None:
+            canvas = layer
+        else:
+            canvas = cv2.resize(canvas, (gw, gh),
+                                interpolation=cv2.INTER_LINEAR) + layer
+    canvas = cv2.resize(canvas, (w, h), interpolation=cv2.INTER_LINEAR)
+    np.multiply(canvas, 255.0 / sum(amps), out=canvas)
+    return np.clip(canvas, 0, 255, out=canvas).astype(np.uint8)
 
 
 def _smooth_field(rng: np.random.RandomState, h: int, w: int,
@@ -85,7 +107,7 @@ class SyntheticFlowDataset(FlowDataset):
         angle = rng.uniform(-0.03, 0.03)
         log_scale = rng.uniform(-0.04, 0.04)
         tx, ty = rng.uniform(-0.5, 0.5, 2) * self.max_flow
-        ys, xs = np.mgrid[0:h, 0:w].astype(np.float32)
+        ys, xs = _pixel_grid(h, w)
         cx, cy = (w - 1) / 2.0, (h - 1) / 2.0
         dx, dy = xs - cx, ys - cy
         s = np.exp(log_scale)
@@ -95,14 +117,15 @@ class SyntheticFlowDataset(FlowDataset):
         bump = _smooth_field(rng, h, w, cells=4, scale=0.35 * self.max_flow)
         flow = np.stack([fx, fy], -1) + bump
         # bound to the canvas margin so no sample reads out of bounds
-        mag = np.linalg.norm(flow, axis=-1, keepdims=True)
+        # (limit / max(mag, limit) is 1.0 exactly below the limit — one
+        # fused rescale instead of the old where + masked divide)
         limit = self.max_flow
-        flow = np.where(mag > limit, flow * (limit / np.maximum(mag, 1e-9)),
-                        flow).astype(np.float32)
+        mag = np.sqrt(np.einsum("hwc,hwc->hw", flow, flow))[..., None]
+        flow = (flow * (limit / np.maximum(mag, limit))).astype(np.float32)
 
         im2 = canvas[margin:margin + h, margin:margin + w]
         # im1(x) = canvas(x + margin + flow(x)) = im2(x + flow(x))
-        map_x = xs + margin + flow[..., 0]
-        map_y = ys + margin + flow[..., 1]
+        map_x = (xs + margin) + flow[..., 0]
+        map_y = (ys + margin) + flow[..., 1]
         im1 = cv2.remap(canvas, map_x, map_y, interpolation=cv2.INTER_LINEAR)
         return im1, im2, flow, None

@@ -261,6 +261,26 @@ class Registry:
                 m = self._metrics[name] = Counter(name, help, labelnames)
             return m
 
+    def get_or_gauge(self, name, help, labelnames=()) -> Gauge:
+        """Gauge sibling of :meth:`get_or_counter` — the data loaders share
+        queue-depth/occupancy gauges on the process default registry."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Gauge(name, help, labelnames)
+            return m
+
+    def get_or_histogram(self, name, help, labelnames=(),
+                         buckets=DEFAULT_LATENCY_BUCKETS) -> Histogram:
+        """Histogram sibling of :meth:`get_or_counter` (e.g. the input
+        pipeline's ``raft_data_wait_seconds`` starvation histogram)."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Histogram(name, help, labelnames,
+                                                   buckets)
+            return m
+
     def render(self) -> str:
         with self._lock:
             metrics = list(self._metrics.values())

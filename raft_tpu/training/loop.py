@@ -299,11 +299,18 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
                    f"recompile(s) after the first step — see run log")
     if metrics_path and is_main:
         # end-of-session registry snapshot: the record `tlm summary` reports
-        # and `tlm compare` diffs between two runs
+        # and `tlm compare` diffs between two runs.  The input pipeline
+        # (PrefetchLoader, MPSampleLoader) counts on the process-default
+        # registry — merge its raft_data_* families in so wait-time /
+        # starvation shows up next to the training throughput.
+        from ..telemetry import default_registry
+        data_metrics = {k: v for k, v in default_registry().snapshot().items()
+                        if k.startswith("raft_data_")}
         with open(metrics_path, "a") as f:
             f.write(json.dumps({"event": "run_end",
                                 "final_step": int(state.step),
-                                "metrics": registry.snapshot()},
+                                "metrics": {**registry.snapshot(),
+                                            **data_metrics}},
                                default=str) + "\n")
     return state
 
@@ -327,8 +334,24 @@ def _save_if_finite(path: Path, state: TrainState, log_fn,
     return True
 
 
+def _dp_sharding(pcount: int, tconfig: TrainConfig):
+    """The data-parallel sharding train() will run the step under — so the
+    prefetch thread's ``device_put`` already lands every batch shard on its
+    device instead of repacking inside the jitted step.  Mirrors train()'s
+    DP eligibility; multi-host assembles global arrays per step instead
+    (returns None there)."""
+    if pcount > 1:
+        return None
+    n_dev = len(jax.devices())
+    if n_dev <= 1 or tconfig.batch_size % n_dev != 0:
+        return None
+    from ..parallel.mesh import batch_sharding, make_mesh
+    return batch_sharding(make_mesh())
+
+
 def train_cli(args, config: RAFTConfig) -> int:
-    from ..data.pipeline import PrefetchLoader, batched, synthetic_batches
+    from ..data.pipeline import (BatchBuffers, PrefetchLoader, batched,
+                                 synthetic_batches)
 
     # stage presets carry the official curriculum hyperparameters (steps,
     # lr, batch, crop, decay — TrainConfig.for_stage); explicit flags win
@@ -386,10 +409,32 @@ def train_cli(args, config: RAFTConfig) -> int:
 
     shard_data = pcount > 1 and getattr(args, "shard_data", False)
     mp_loader = None
+    batch_iter = None
+    device_aug = bool(getattr(args, "device_aug", False))
+    prefetch_depth = getattr(args, "prefetch_depth", None) or 2
+    augment_fn = None
     if args.data or args.dataset == "synthetic":
         from ..data.datasets import make_training_dataset
-        ds = make_training_dataset(args.dataset, args.data, tconfig.image_size)
+        ds = make_training_dataset(args.dataset, args.data, tconfig.image_size,
+                                   device_aug=device_aug)
         print(f"[train] {args.dataset}: {len(ds)} samples")
+        if device_aug:
+            # decode-only workers + the jitted FlowAugmentor recipe applied
+            # to whole staged batches in the prefetch thread — the host
+            # ships uint8 frames, the accelerator does the augment math
+            from ..data.augment_device import (DecodeOnlyDataset,
+                                               make_batch_augment_fn,
+                                               make_device_augmentor)
+            ds = DecodeOnlyDataset(ds)
+            batch_aug = make_batch_augment_fn(
+                make_device_augmentor(args.dataset, tconfig.image_size),
+                hw=ds.canonical_hw)
+
+            def augment_fn(batch, key):
+                return tuple(batch_aug(key, *batch[:3]))
+
+            print(f"[train] device-side augmentation on "
+                  f"(src {ds.canonical_hw} -> crop {tconfig.image_size})")
         workers = getattr(args, "workers", 0)
         seed = tconfig.seed
         local_batch = tconfig.batch_size
@@ -418,23 +463,43 @@ def train_cli(args, config: RAFTConfig) -> int:
         if workers >= 1:
             from ..data.mp_loader import MPSampleLoader
             stall = getattr(args, "stall_timeout", 300.0)
+            shm_slots = getattr(args, "shm_slots", None)
+            transport = "pickle" if shm_slots == 0 else "shm"
             mp_loader = MPSampleLoader(
                 ds, num_workers=workers, seed=seed,
                 start_method=getattr(args, "mp_start", "forkserver"),
-                stall_timeout=None if not stall else stall)
+                stall_timeout=None if not stall else stall,
+                transport=transport,
+                shm_slots=shm_slots if shm_slots else None)
             sample_iter = iter(mp_loader)
-            print(f"[train] {workers} decode/augment worker processes")
+            print(f"[train] {workers} decode{'' if device_aug else '/augment'}"
+                  f" worker processes ({transport} transport)")
         else:
             sample_iter = ds.sample_iter(seed=seed)
-        raw = batched(sample_iter, local_batch)
+        # copy-on-arrival into pre-allocated ring buffers: no per-batch
+        # np.stack allocation, and the shm transport's view-lifetime
+        # contract is honored (pipeline.BatchBuffers)
+        collator = BatchBuffers.for_loader(local_batch, prefetch_depth)
+        raw = batched(sample_iter, local_batch, collator=collator)
+        # device-aug keys must decorrelate across hosts (each host augments
+        # DIFFERENT samples, so identical per-row keys would halve the
+        # global batch's augmentation diversity); a distinct prime keeps
+        # this independent of shard_data's sample-seed offset
+        aug_seed = seed + 999_983 * jax.process_index()
         batch_iter = PrefetchLoader(
-            _local_slices(raw) if (pcount > 1 and not shard_data) else raw)
+            _local_slices(raw) if (pcount > 1 and not shard_data) else raw,
+            buffer_size=prefetch_depth,
+            sharding=_dp_sharding(pcount, tconfig),
+            augment_fn=augment_fn, augment_seed=aug_seed)
     else:
         print("[train] no --data: running on RANDOM batches (smoke mode; "
               "use --dataset synthetic for data with real ground truth)")
         size = (64, 96)
         raw = synthetic_batches(tconfig.batch_size, size)
-        batch_iter = PrefetchLoader(_local_slices(raw) if pcount > 1 else raw)
+        batch_iter = PrefetchLoader(
+            _local_slices(raw) if pcount > 1 else raw,
+            buffer_size=prefetch_depth,
+            sharding=_dp_sharding(pcount, tconfig))
 
     ckpt_dir = str(Path(args.out) / tconfig.ckpt_dir)
     try:
@@ -443,9 +508,13 @@ def train_cli(args, config: RAFTConfig) -> int:
               trace_steps=getattr(args, "trace_steps", None) or 4,
               init_params=init_params)
     finally:
+        # drain order matters: stop the prefetch pump first (it would keep
+        # decoding and device_put-ing after a max_steps break, pinning
+        # buffered device batches), then reap the worker processes + feeder
+        # — even when train() raises (e.g. halt_on_nonfinite)
+        if isinstance(batch_iter, PrefetchLoader):
+            batch_iter.close()
         if mp_loader is not None:
-            # reap worker processes + feeder even when train() raises (e.g.
-            # the halt_on_nonfinite FloatingPointError)
             mp_loader.close()
 
     metrics_path = Path(ckpt_dir) / "metrics.jsonl"

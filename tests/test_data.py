@@ -1,13 +1,14 @@
-"""Data pipeline tests: augmentors, dataset readers over synthetic directory
-trees, padding, prefetch loader."""
+"""Data pipeline tests: augmentors (host and device-side parity), dataset
+readers over synthetic directory trees, padding, batching/collation,
+shared-memory transport, prefetch loader."""
 
 import os
 
 import numpy as np
 import pytest
 
-from raft_tpu.data import (FlowAugmentor, FlyingChairs, MpiSintel,
-                           PairAugmentor, PairList, PrefetchLoader,
+from raft_tpu.data import (BatchBuffers, FlowAugmentor, FlyingChairs,
+                           MpiSintel, PairAugmentor, PairList, PrefetchLoader,
                            batch_samples, batched, pad_to_multiple,
                            synthetic_batches, unpad)
 from raft_tpu.utils import write_flo
@@ -235,6 +236,76 @@ def test_batched_and_prefetch_loader():
     assert float(np.asarray(batches[0][0]).sum()) == 6.0
 
 
+def test_batched_drop_remainder_and_partial_counter():
+    """The epoch-final partial batch must be yieldable (drop_remainder=False)
+    and COUNTED either way — the silent-drop regression of ISSUE 5."""
+    from raft_tpu.telemetry.registry import default_registry
+
+    counter = default_registry().get_or_counter(
+        "raft_data_partial_batches_total", "")
+    before = counter.value
+    samples = [(np.full(3, i, np.float32),) for i in range(5)]
+    kept = list(batched(iter(samples), 2, drop_remainder=False))
+    assert len(kept) == 3
+    assert kept[-1][0].shape == (1, 3)
+    np.testing.assert_array_equal(kept[-1][0][0], 4.0)
+    dropped = list(batched(iter(samples), 2))      # default still drops...
+    assert len(dropped) == 2
+    assert counter.value == before + 2             # ...but both runs counted
+    # no partial batch -> no count
+    list(batched(iter(samples[:4]), 2))
+    assert counter.value == before + 2
+
+
+def test_batch_buffers_copy_on_arrival_and_ring_reuse():
+    """The collator must snapshot each sample as it arrives (shm views are
+    invalidated on the next iteration) and reuse buffers only after
+    ``depth`` emits."""
+    col = BatchBuffers(2, depth=2)
+    src = np.arange(6, dtype=np.float32).reshape(2, 3)
+    col.add(0, (src[0].copy(),))
+    col.add(1, (src[1].copy(),))
+    b1 = col.emit(2)
+    # ring depth 2: the NEXT batch must not overwrite b1's storage...
+    col.add(0, (np.full(3, 7, np.float32),))
+    col.add(1, (np.full(3, 8, np.float32),))
+    b2 = col.emit(2)
+    np.testing.assert_array_equal(b1[0], src)
+    np.testing.assert_array_equal(b2[0][0], 7.0)
+    # ...but the third emit wraps onto b1's buffers (the documented ring
+    # contract: hold at most depth-1 batches)
+    col.add(0, (np.zeros(3, np.float32),))
+    col.add(1, (np.zeros(3, np.float32),))
+    b3 = col.emit(2)
+    assert b3[0] is b1[0]
+
+
+def test_prefetch_loader_close_stops_pump_and_context_manager():
+    """close() (and the context manager) must stop the pump thread mid-
+    stream — the early-exit (max_steps break) path that previously kept
+    decoding and staging forever."""
+    import itertools
+    import time
+
+    produced = [0]
+
+    def gen():
+        for i in itertools.count():
+            produced[0] = i
+            yield (np.full(4, i, np.float32),)
+
+    with PrefetchLoader(gen(), buffer_size=2) as loader:
+        first = next(loader)
+        assert np.asarray(first[0]).shape == (4,)
+    assert not loader._thread.is_alive()
+    high_water = produced[0]
+    time.sleep(0.15)
+    assert produced[0] == high_water      # pump really stopped
+    with pytest.raises(StopIteration):    # closed loader refuses to serve
+        next(loader)
+    loader.close()                        # idempotent
+
+
 def test_synthetic_batches():
     it = synthetic_batches(2, (16, 24))
     im1, im2, flow, valid = next(it)
@@ -272,6 +343,96 @@ def test_native_decode_routing_by_bit_depth(tmp_path):
     got = _read_image(p)
     want = cv2.imdecode(np.frombuffer(bytes(png16), np.uint8), cv2.IMREAD_COLOR)
     np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------------------- shared-memory transport
+
+def test_shm_ring_reuse_under_slot_exhaustion():
+    """More in-flight samples than slots: workers must block on the free
+    list and recycled slots must carry uncorrupted content.  2 slots is the
+    documented minimum (1 pending at the consumer + 1 circulating)."""
+    from raft_tpu.data.mp_loader import MPSampleLoader
+    from raft_tpu.data.synthetic import SyntheticFlowDataset
+
+    ds = SyntheticFlowDataset(size=(24, 32), length=9, seed=5)
+    expected = {ds[i][2].tobytes(): 2 for i in range(9)}
+    loader = MPSampleLoader(ds, num_workers=2, seed=0, epochs=2,
+                            transport="shm", shm_slots=2)
+    try:
+        for sample in loader:
+            # contract: views are valid only until the next iteration —
+            # hash in place, no copy needed
+            expected[sample[2].tobytes()] -= 1
+            assert sample[0].dtype == np.float32
+    finally:
+        loader.close()
+    assert all(v == 0 for v in expected.values()), expected
+
+
+def test_shm_transport_deterministic_stream():
+    """shm transport changes where bytes land, not what is computed: a
+    no-shuffle single-worker stream must be reproducible across loaders and
+    byte-identical to the pickle transport."""
+    from raft_tpu.data.mp_loader import MPSampleLoader
+    from raft_tpu.data.synthetic import SyntheticFlowDataset
+
+    def stream(transport):
+        ds = SyntheticFlowDataset(size=(32, 48), length=4, seed=2,
+                                  augmentor=FlowAugmentor((24, 32)))
+        loader = MPSampleLoader(ds, num_workers=1, seed=7, shuffle=False,
+                                epochs=1, transport=transport, shm_slots=3)
+        try:
+            return [tuple(np.copy(f) for f in s) for s in loader]
+        finally:
+            loader.close()
+
+    a, b, c = stream("shm"), stream("shm"), stream("pickle")
+    assert len(a) == 4
+    for sa, sb, sc in zip(a, b, c):
+        for x, y, z in zip(sa, sb, sc):
+            np.testing.assert_array_equal(x, y)
+            np.testing.assert_array_equal(x, z)
+
+
+class _Lumpy:
+    """Non-uniform sample shapes — must be rejected by the shm transport.
+    Module level: forkserver workers unpickle the dataset by reference."""
+
+    augmentor = None
+
+    def __len__(self):
+        return 4
+
+    def __getitem__(self, idx):
+        side = 8 if idx == 0 else 9
+        return (np.zeros((side, 8, 3), np.float32),)
+
+
+def test_shm_transport_rejects_nonuniform_samples():
+    """A sample whose shape disagrees with the probed SampleSpec must
+    surface as a worker error, never silent slot corruption."""
+    from raft_tpu.data.mp_loader import MPSampleLoader
+
+    loader = MPSampleLoader(_Lumpy(), num_workers=1, seed=0, shuffle=False,
+                            epochs=1, transport="shm", shm_slots=2)
+    with pytest.raises(RuntimeError, match="data worker failed"):
+        for _ in loader:
+            pass
+
+
+def test_sample_spec_layout_and_views():
+    from raft_tpu.data.mp_loader import SampleSpec
+
+    sample = (np.arange(12, dtype=np.uint8).reshape(2, 2, 3),
+              np.ones((2, 2), np.float32))
+    spec = SampleSpec.from_sample(sample)
+    assert spec.offsets[0] == 0 and spec.offsets[1] % 64 == 0
+    buf = bytearray(spec.nbytes)
+    spec.write(buf, sample)
+    views = spec.views(buf)
+    for v, s in zip(views, sample):
+        assert v.dtype == s.dtype and v.shape == s.shape
+        np.testing.assert_array_equal(v, s)
 
 
 def test_synthetic_dataset_reports_ground_truth():
@@ -349,3 +510,129 @@ def test_things3d_dataset_real_layout(tmp_path):
     assert im1.shape == (h, w, 3) and flow.shape == (h, w, 2)
     assert np.all(flow[..., 0] == 6.0) and np.all(flow[..., 1] == 0.0)
     assert valid is None or valid.all()
+
+
+# ----------------------------------------------- device-side augmentation
+
+def _parity_inputs(h=96, w=128, seed=0):
+    rng = np.random.RandomState(seed)
+    im1 = rng.randint(0, 255, (h, w, 3), np.uint8)
+    im2 = rng.randint(0, 255, (h, w, 3), np.uint8)
+    ys, xs = np.mgrid[0:h, 0:w].astype(np.float32)
+    flow = np.stack([0.03 * xs + 2.0 + 3 * np.sin(ys / 17),
+                     -0.02 * ys + 1.0 + 2 * np.cos(xs / 23)],
+                    -1).astype(np.float32)
+    return im1, im2, flow
+
+
+def test_device_aug_parity_shared_params():
+    """The jitted device augmentor must reproduce the numpy augmentor to
+    1e-5 when BOTH consume the same sampled parameters — across photometric
+    draws, scale/stretch resampling, flips, crops and the eraser (ISSUE 5
+    acceptance).  White-noise frames are the worst case for the resample
+    (max per-pixel gradient), so this bound is not input-flattered."""
+    import jax.numpy as jnp
+
+    from raft_tpu.data.augment_device import (DeviceFlowAugmentor,
+                                              params_from_host)
+
+    im1, im2, flow = _parity_inputs()
+    h, w = im1.shape[:2]
+    dev = DeviceFlowAugmentor((64, 96))
+    saw_resample = saw_flip = saw_erase = False
+    for seed in range(25):
+        host = FlowAugmentor((64, 96), rng=np.random.RandomState(seed))
+        p = host.sample_params(h, w)
+        saw_resample |= (p["nh"], p["nw"]) != (h, w)
+        saw_flip |= p["hflip"] or p["vflip"]
+        saw_erase |= bool(p["erase_rects"])
+        ref = host.apply_params(im1, im2, flow, p)
+        out = dev.apply_params(params_from_host(p), jnp.asarray(im1),
+                               jnp.asarray(im2), jnp.asarray(flow))
+        for name, a, b in zip(("im1", "im2", "flow", "valid"), ref, out):
+            np.testing.assert_allclose(np.asarray(b), a, rtol=1e-5,
+                                       atol=1e-5, err_msg=f"{name} seed {seed}")
+    assert saw_resample and saw_flip and saw_erase   # coverage, not luck
+
+
+def test_device_aug_flow_scale_and_flip_sign_conventions():
+    """Flow values must scale by the ROUNDED (nw/w, nh/h) resize factors and
+    flip sign with the mirrored axis — the conventions a training pipeline
+    silently corrupts if either side drifts."""
+    import jax.numpy as jnp
+
+    from raft_tpu.data.augment_device import (AugParams, DeviceFlowAugmentor)
+
+    h, w = 64, 64
+    im = np.zeros((h, w, 3), np.uint8)
+    flow = np.tile(np.array([3.0, -2.0], np.float32), (h, w, 1))
+    dev = DeviceFlowAugmentor((32, 32), photometric=False)
+
+    def params(nh, nw, hflip=False, vflip=False):
+        return AugParams(contrast=jnp.float32(1), gamma=jnp.float32(0),
+                         brightness=jnp.float32(0), nh=jnp.int32(nh),
+                         nw=jnp.int32(nw), hflip=jnp.bool_(hflip),
+                         vflip=jnp.bool_(vflip), y0=jnp.int32(0),
+                         x0=jnp.int32(0), erase_count=jnp.int32(0),
+                         erase_rects=jnp.zeros((2, 4), jnp.int32))
+
+    # 2x resample doubles flow
+    _, _, f2, v2 = dev.apply_params(params(2 * h, 2 * w), im, im,
+                                    jnp.asarray(flow))
+    np.testing.assert_allclose(np.asarray(f2[..., 0]), 6.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(f2[..., 1]), -4.0, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(v2), 1.0)
+    # horizontal flip negates x-flow; vertical flip negates y-flow
+    _, _, fh, _ = dev.apply_params(params(h, w, hflip=True), im, im,
+                                   jnp.asarray(flow))
+    np.testing.assert_allclose(np.asarray(fh[..., 0]), -3.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(fh[..., 1]), -2.0, atol=1e-6)
+    _, _, fv, _ = dev.apply_params(params(h, w, vflip=True), im, im,
+                                   jnp.asarray(flow))
+    np.testing.assert_allclose(np.asarray(fv[..., 0]), 3.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(fv[..., 1]), 2.0, atol=1e-6)
+
+
+def test_device_aug_batched_entry_deterministic():
+    """make_batch_augment_fn: fixed output shapes/dtypes at any batch, and
+    the same key must reproduce the same augmented batch (the PRNG-keyed
+    determinism the PrefetchLoader hook relies on)."""
+    import jax
+
+    from raft_tpu.data.augment_device import (DeviceFlowAugmentor,
+                                              make_batch_augment_fn)
+
+    im1, im2, flow = _parity_inputs(h=64, w=96, seed=3)
+    b = 3
+    batch = tuple(np.stack([x] * b) for x in (im1, im2, flow))
+    fn = make_batch_augment_fn(DeviceFlowAugmentor((32, 48)), hw=(64, 96))
+    key = jax.random.PRNGKey(11)
+    o1 = fn(key, *batch)
+    o2 = fn(key, *batch)
+    assert [np.asarray(x).shape for x in o1] == [
+        (b, 32, 48, 3), (b, 32, 48, 3), (b, 32, 48, 2), (b, 32, 48)]
+    for a, c in zip(o1, o2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    # rows draw independent params
+    assert not np.array_equal(np.asarray(o1[2][0]), np.asarray(o1[2][1]))
+    # images normalized to [0, 1]
+    assert float(np.asarray(o1[0]).max()) <= 1.0
+
+
+def test_decode_only_dataset_ships_uint8():
+    from raft_tpu.data.augment_device import DecodeOnlyDataset
+    from raft_tpu.data.synthetic import SyntheticFlowDataset
+
+    ds = DecodeOnlyDataset(SyntheticFlowDataset(size=(24, 32), length=3))
+    assert ds.canonical_hw == (24, 32)
+    im1, im2, flow = ds[1]
+    assert im1.dtype == np.uint8 and im1.shape == (24, 32, 3)
+    assert flow.dtype == np.float32 and flow.shape == (24, 32, 2)
+    # and it refuses sparse ground truth (valid is host-only)
+    class _Sparse:
+        def _load(self, idx):
+            z = np.zeros((8, 8), np.float32)
+            return (np.zeros((8, 8, 3), np.uint8),) * 2 + (
+                np.zeros((8, 8, 2), np.float32), z)
+    with pytest.raises(ValueError, match="dense ground truth"):
+        DecodeOnlyDataset(_Sparse(), canonical_hw=(8, 8))[0]

@@ -130,6 +130,13 @@ def summary_lines(path) -> List[str]:
                                                         dict):
             for name, val in sorted(rec["metrics"].items()):
                 out.append(f"  {name:<32} {_fmt_val(val)}")
+            wait = rec["metrics"].get("raft_data_wait_seconds")
+            if isinstance(wait, dict) and wait.get("count"):
+                out.append(
+                    f"  input-pipeline wait: {wait['mean'] * 1000:.1f} "
+                    f"ms/batch over {wait['count']} get(s) — the train-step "
+                    f"starvation signal (raise --workers/--prefetch-depth "
+                    f"if it rivals the step time)")
         if rec.get("event") == "nonfinite":
             out.append(f"  NONFINITE at stage {rec.get('stage')!r} "
                        f"({rec.get('bad_values')} value(s))")
