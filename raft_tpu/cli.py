@@ -26,6 +26,15 @@ from pathlib import Path
 import numpy as np
 
 
+def _iters_policy_spec(spec: str) -> str:
+    """argparse type hook: validate --iters-policy at parse time (a typo'd
+    policy must exit 2 with the parser's usage line, not traceback deep in
+    the model)."""
+    from .config import parse_iters_policy
+    parse_iters_policy(spec)        # raises ValueError on malformed specs
+    return spec
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="raft_tpu",
                                 description="TPU-native RAFT optical flow")
@@ -43,6 +52,16 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--small", action="store_true", help="raft-small variant")
     p.add_argument("--iters", type=int, default=None,
                    help="GRU iterations (default: 32 full / 12 small)")
+    p.add_argument("--iters-policy", type=_iters_policy_spec, default=None,
+                   metavar="POLICY",
+                   help="iteration policy: 'fixed' (default) runs --iters "
+                        "GRU iterations; 'converge:eps[:min_iters]' adds a "
+                        "per-sample early exit — a sample whose mean 1/8-"
+                        "grid flow update ‖Δflow‖ drops below eps (pixels) "
+                        "freezes in place (static shapes, no recompiles), "
+                        "and inference stops once the whole batch has "
+                        "converged.  Iterations used are reported via the "
+                        "raft_iters_used histogram (TUNING.md round 8)")
     p.add_argument("--size", type=int, nargs=2, default=(432, 1024),
                    metavar=("H", "W"), help="inference resolution")
     p.add_argument("--batch", type=int, default=None,
@@ -334,6 +353,8 @@ def _make_config(args):
         overrides["gru_block_rows"] = args.gru_block_rows
     if args.corr_lookup is not None:
         overrides["corr_lookup"] = args.corr_lookup
+    if getattr(args, "iters_policy", None) is not None:
+        overrides["iters_policy"] = args.iters_policy
     if args.iters is not None:
         overrides["iters"] = args.iters
     if args.small:

@@ -12,6 +12,55 @@ import dataclasses
 from typing import Optional, Tuple
 
 
+def parse_iters_policy(spec: str):
+    """Parse an iteration policy spec into ``(kind, eps, min_iters)``.
+
+    ``"fixed"``                    -> ``("fixed", None, None)``
+    ``"converge:EPS"``             -> ``("converge", EPS, 1)``
+    ``"converge:EPS:MIN_ITERS"``   -> ``("converge", EPS, MIN_ITERS)``
+
+    ``EPS`` is the early-exit threshold in pixels at the 1/8 recurrence
+    grid: a sample is *converged* once the per-sample mean L2 norm of the
+    GRU's flow update ``‖Δflow‖`` drops below it (after at least
+    ``MIN_ITERS`` iterations).  ``converge:0`` never triggers (the norm is
+    never < 0), so it is the bit-exact twin of ``fixed`` — what the
+    equivalence tests pin.  A malformed spec raises ValueError — same
+    no-silent-fallback contract as ``corr_lookup``/``gru_impl``.
+    """
+    if spec == "fixed":
+        return ("fixed", None, None)
+    parts = spec.split(":")
+    if parts[0] != "converge" or len(parts) not in (2, 3):
+        raise ValueError(
+            f"iters_policy must be 'fixed' or 'converge:eps[:min_iters]', "
+            f"got {spec!r}")
+    try:
+        eps = float(parts[1])
+    except ValueError:
+        raise ValueError(f"iters_policy {spec!r}: eps {parts[1]!r} is not "
+                         f"a number")
+    if not eps >= 0.0:          # also rejects NaN
+        raise ValueError(f"iters_policy {spec!r}: eps must be >= 0")
+    min_iters = 1
+    if len(parts) == 3:
+        try:
+            min_iters = int(parts[2])
+        except ValueError:
+            raise ValueError(f"iters_policy {spec!r}: min_iters "
+                             f"{parts[2]!r} is not an integer")
+        if min_iters < 1:
+            raise ValueError(f"iters_policy {spec!r}: min_iters must "
+                             f"be >= 1")
+    return ("converge", eps, min_iters)
+
+
+def adaptive_iters(spec: str) -> bool:
+    """True when ``spec`` enables the per-sample early exit (validates as a
+    side effect) — the one test every policy consumer needs, so a future
+    policy kind means touching this helper, not every call site."""
+    return parse_iters_policy(spec)[0] == "converge"
+
+
 def init_rng(seed: int = 0):
     """The one sanctioned source of init randomness.
 
@@ -95,6 +144,18 @@ class RAFTConfig:
     # EPE 1.0007 (f32) vs 1.0016 (bf16) on the trained flagship checkpoint,
     # +0.0009 EPE for ~1.5x measured TPU throughput (PERF.md round 5).
     compute_dtype: str = "float32"
+    # Iteration policy for the recurrent update loop (parse_iters_policy):
+    # 'fixed' runs exactly `iters` GRU iterations; 'converge:eps[:min_iters]'
+    # adds a per-sample early-exit criterion — a sample whose mean 1/8-grid
+    # flow update ‖Δflow‖ drops below eps (pixels) is FROZEN in place
+    # (masked carry update; shapes stay static so raftlint R2 holds and one
+    # executable serves every difficulty mix), and inference takes a
+    # whole-batch lax.while_loop fast path that stops once every sample has
+    # converged (or at `iters`, whichever first).  Train/differentiable
+    # paths keep the masked lax.scan form (reverse-mode through while_loop
+    # is undefined), composing with remat_iters and scan_unroll.
+    # 'converge:0' is the bit-exact twin of 'fixed'.  PERF.md round 8.
+    iters_policy: str = "fixed"
     # Rematerialize each GRU iteration during backprop (memory/FLOPs trade).
     remat_iters: bool = True
     # lax.scan unroll factor for the GRU iteration loop (1 = no unrolling).

@@ -1,4 +1,5 @@
 from .encoders import apply_encoder, init_encoder
-from .raft import RAFTOutput, init_raft, make_inference_fn, raft_forward
+from .raft import (RAFTOutput, init_raft, make_counted_inference_fn,
+                   make_inference_fn, raft_forward)
 from .update import (apply_basic_update_block, apply_small_update_block,
                      init_basic_update_block, init_small_update_block)

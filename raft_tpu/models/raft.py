@@ -26,7 +26,7 @@ from typing import Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..config import RAFTConfig
+from ..config import RAFTConfig, parse_iters_policy
 from ..lint.contracts import contract
 from ..ops import spmd
 from ..ops.coords import coords_grid, upflow8
@@ -45,6 +45,12 @@ class RAFTOutput(NamedTuple):
     flow: jax.Array                      # [B, H, W, 2] final full-res flow
     flow_iters: Optional[jax.Array]      # [iters, B, H, W, 2] or None
     flow_lr: jax.Array                   # [B, H/8, W/8, 2] final low-res flow
+    # [B] int32: GRU iterations each sample actually spent ACTIVE (= `iters`
+    # under iters_policy='fixed'; < iters for samples that hit the converge
+    # early-exit).  Under early exit, flow_iters entries past iters_used[b]
+    # repeat sample b's frozen flow — never stale intermediates — so the
+    # sequence loss and --dump-flow stay correct.
+    iters_used: Optional[jax.Array] = None
 
 
 def init_raft(key: jax.Array, config: RAFTConfig) -> Dict[str, dict]:
@@ -97,6 +103,16 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
     iters = config.iters if iters is None else iters
     all_flows = train if all_flows is None else all_flows
     cnet_norm = "none" if config.small else "batch"
+    # validated for every path up front — a typo'd policy must raise, not
+    # silently run fixed (the corr_lookup/gru_impl contract)
+    policy, eps, min_iters = parse_iters_policy(config.iters_policy)
+    adaptive = policy == "converge"
+    if adaptive and spmd.spatial_axis() is not None:
+        raise NotImplementedError(
+            "iters_policy='converge:...' under row-sharded (spatial) "
+            "execution is not wired: each shard would measure ‖Δflow‖ on "
+            "its local slab only and freeze samples at different "
+            "iterations; use iters_policy='fixed'.")
     if config.gru_impl not in ("xla", "pallas"):
         # same silent-fallback hazard as corr_lookup: a typo must not
         # quietly run the other GRU implementation
@@ -255,8 +271,10 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
         gru_ctx = precompute_gru_ctx(params["update_block"]["gru"], inp,
                                      config.hidden_dim, small=config.small)
 
-    def step(carry, _):
-        net, coords1, _ = carry
+    def gru_step(net, coords1):
+        """One GRU update — shared by every loop form below.  Returns the
+        updated (net, coords1, mask) plus the per-sample mean L2 norm of
+        the flow update at the 1/8 grid, the converge-policy criterion."""
         coords1 = jax.lax.stop_gradient(coords1)   # reference RAFT.py:93 / official
         with stage("raft/corr_lookup"):
             corr = lookup(coords=coords1).astype(cdt)
@@ -267,20 +285,96 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                                               corr, flow, gru_ctx=gru_ctx)
         delta_flow = nan_guard(delta_flow, "raft/update")
         coords1 = coords1 + delta_flow.astype(jnp.float32)
-        if all_flows:
-            with stage("raft/upsample"):
-                out = upsample(coords1 - coords0, mask)
-        else:
-            out = None
-        return (net, coords1, mask), out
+        dn = jnp.sqrt(jnp.sum(jnp.square(delta_flow.astype(jnp.float32)),
+                              axis=-1)).mean(axis=(1, 2))        # [B]
+        return net, coords1, mask, dn
 
-    if config.remat_iters and train:
-        step = jax.checkpoint(step)
+    def emit(coords1, mask):
+        if not all_flows:
+            return None
+        with stage("raft/upsample"):
+            return upsample(coords1 - coords0, mask)
 
     mask0 = None if config.small else jnp.zeros((B, h, w, 64 * 9), cdt)
-    (net, coords1, mask), ys = jax.lax.scan(
-        step, (net, coords1, mask0), None, length=iters,
-        unroll=min(config.scan_unroll, iters))
+
+    if not adaptive:
+        # -- fixed policy: the plain scan, structurally unchanged ---------
+        def step(carry, _):
+            net, coords1, _ = carry
+            net, coords1, mask, _ = gru_step(net, coords1)
+            return (net, coords1, mask), emit(coords1, mask)
+
+        if config.remat_iters and train:
+            step = jax.checkpoint(step)
+
+        (net, coords1, mask), ys = jax.lax.scan(
+            step, (net, coords1, mask0), None, length=iters,
+            unroll=min(config.scan_unroll, iters))
+        iters_used = jnp.full((B,), iters, jnp.int32)
+    else:
+        # -- converge policy: per-sample masked freeze, static shapes -----
+        # A sample whose update norm drops below eps is FROZEN: its carry
+        # entries (net, coords, mask) keep their current values through all
+        # remaining iterations, so later emitted flows repeat the frozen
+        # flow exactly.  Shapes never depend on the data — one executable
+        # serves every difficulty mix (raftlint R2 discipline).
+        def masked_iter(i, net, coords1, mask, converged, nused):
+            active = ~converged                                    # [B]
+            net2, coords2, mask2, dn = gru_step(net, coords1)
+
+            def keep(new, old):
+                a = active.reshape((B,) + (1,) * (new.ndim - 1))
+                return jnp.where(a, new, old)
+
+            net = keep(net2, net)
+            coords1 = keep(coords2, coords1)
+            mask = keep(mask2, mask) if mask2 is not None else None
+            # eps=0 never fires (a norm is never < 0): bit-exact 'fixed'
+            converged = converged | (active & (dn < eps)
+                                     & (i + 1 >= min_iters))
+            nused = nused + active.astype(jnp.int32)
+            return net, coords1, mask, converged, nused
+
+        conv0 = jnp.zeros((B,), bool)
+        used0 = jnp.zeros((B,), jnp.int32)
+        if train or all_flows:
+            # differentiable form: masked scan over all `iters` iterations
+            # (reverse-mode through while_loop is undefined); frozen
+            # samples cost no numerics change, and remat/unroll compose
+            # exactly as for 'fixed'
+            def step(carry, i):
+                net, coords1, mask, converged, nused = carry
+                net, coords1, mask, converged, nused = masked_iter(
+                    i, net, coords1, mask, converged, nused)
+                return (net, coords1, mask, converged, nused), \
+                    emit(coords1, mask)
+
+            if config.remat_iters and train:
+                step = jax.checkpoint(step)
+
+            (net, coords1, mask, _, iters_used), ys = jax.lax.scan(
+                step, (net, coords1, mask0, conv0, used0),
+                jnp.arange(iters), unroll=min(config.scan_unroll, iters))
+        else:
+            # inference fast path: whole-batch early exit — the loop stops
+            # as soon as EVERY sample has converged (or at `iters`), so
+            # wall-clock tracks the hardest sample in the batch, not the
+            # declared maximum
+            def w_cond(carry):
+                i = carry[0]
+                converged = carry[4]
+                return (i < iters) & ~jnp.all(converged)
+
+            def w_body(carry):
+                i, net, coords1, mask, converged, nused = carry
+                net, coords1, mask, converged, nused = masked_iter(
+                    i, net, coords1, mask, converged, nused)
+                return (i + 1, net, coords1, mask, converged, nused)
+
+            (_, net, coords1, mask, _, iters_used) = jax.lax.while_loop(
+                w_cond, w_body,
+                (jnp.int32(0), net, coords1, mask0, conv0, used0))
+            ys = None
 
     flow_lr = coords1 - coords0
     if all_flows:
@@ -301,7 +395,8 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
         new_params["cnet"] = jax.tree.map(
             lambda new, old: new.astype(old.dtype),
             new_cnet_params, orig_params["cnet"])
-    return RAFTOutput(flow=flow, flow_iters=flow_iters, flow_lr=flow_lr), new_params
+    return RAFTOutput(flow=flow, flow_iters=flow_iters, flow_lr=flow_lr,
+                      iters_used=iters_used), new_params
 
 
 def make_inference_fn(config: RAFTConfig, iters: Optional[int] = None):
@@ -310,4 +405,17 @@ def make_inference_fn(config: RAFTConfig, iters: Optional[int] = None):
         out, _ = raft_forward(params, image1, image2, config, iters=iters,
                               train=False, all_flows=False)
         return out.flow
+    return fn
+
+
+def make_counted_inference_fn(config: RAFTConfig,
+                              iters: Optional[int] = None):
+    """A jittable (params, image1, image2) -> (flow, iters_used) function —
+    the serving/bench twin of :func:`make_inference_fn` that also returns
+    the per-sample GRU iteration count ([B] int32), the adaptive-compute
+    observable behind the ``raft_iters_used`` histogram."""
+    def fn(params, image1, image2):
+        out, _ = raft_forward(params, image1, image2, config, iters=iters,
+                              train=False, all_flows=False)
+        return out.flow, out.iters_used
     return fn

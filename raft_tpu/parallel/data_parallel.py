@@ -67,10 +67,17 @@ def make_pjit_train_step(config: RAFTConfig, tconfig: TrainConfig, tx,
 
 
 def make_dp_eval_fn(config: RAFTConfig, mesh: Mesh,
-                    iters: Optional[int] = None, axis: str = DATA_AXIS):
-    """Returns jitted (params, im1, im2) -> flow, batch sharded over ``axis``."""
-    inner = make_eval_step(config, iters=iters)
+                    iters: Optional[int] = None, axis: str = DATA_AXIS,
+                    with_iters: bool = False):
+    """Returns jitted (params, im1, im2) -> flow, batch sharded over ``axis``
+    (``with_iters``: -> (flow, iters_used), both batch-sharded).
+
+    Composes with iters_policy='converge:...': the inference while_loop has
+    no collectives, so each shard legally exits as soon as ITS slice of the
+    batch has converged — per-device early exit, no cross-shard sync."""
+    inner = make_eval_step(config, iters=iters, with_iters=with_iters)
+    out_specs = (P(axis), P(axis)) if with_iters else P(axis)
     f = compat_shard_map(inner, mesh=mesh,
                       in_specs=(P(), P(axis), P(axis)),
-                      out_specs=P(axis))
+                      out_specs=out_specs)
     return jax.jit(f)

@@ -91,9 +91,18 @@ class MicroBatcher:
         finally:
             self._observe("inflight", -1)
             self._observe("batch_latency", time.monotonic() - t0)
+        # converge-policy engines return (flows, per-row iters_used); only
+        # REAL rows are accounted — padding rows repeat the last request
+        # and would skew the raft_iters_used distribution
+        iters_used = None
+        if isinstance(flows, tuple):
+            flows, iters_used = flows
         now = time.monotonic()
         for i, r in enumerate(batch):
             r.batch_real, r.batch_padded = n, padded
+            if iters_used is not None:
+                r.iters_used = int(iters_used[i])
+                self._observe("iters_used", float(iters_used[i]))
             self._observe("queue_latency", r.dequeued_at - r.enqueued_at)
             self._observe("request_latency", now - r.enqueued_at)
             self._observe("requests", "ok", 1)

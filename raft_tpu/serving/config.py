@@ -10,7 +10,9 @@ steady-state serving never traces or compiles — the raftlint R2 discipline
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
+
+from ..config import parse_iters_policy
 
 
 def parse_buckets(spec: str) -> Tuple[Tuple[int, int], ...]:
@@ -83,6 +85,14 @@ class ServeConfig:
     # traffic.  Off skips straight to lazy compiles (first request per shape
     # pays the compile — useful only for quick experiments).
     warmup: bool = True
+    # Iteration policy of the served model (config.parse_iters_policy):
+    # None inherits the model config; 'converge:eps[:min_iters]' turns on
+    # per-sample early exit — shapes stay static so the batcher and the
+    # warm compile grid are untouched, but the policy IS part of the
+    # engine-cache key: every warmed executable is pinned to the policy it
+    # was compiled under, and each request's iterations-used lands in the
+    # raft_iters_used histogram on /metrics.
+    iters_policy: Optional[str] = None
 
     def __post_init__(self):
         if self.batch_steps is None:
@@ -100,6 +110,8 @@ class ServeConfig:
             raise ValueError(f"queue_depth must be >= 1, got {self.queue_depth}")
         if self.dp_devices < 1:
             raise ValueError(f"dp_devices must be >= 1, got {self.dp_devices}")
+        if self.iters_policy is not None:
+            parse_iters_policy(self.iters_policy)   # typo -> raise, up front
         steps = tuple(sorted(set(self.batch_steps)))
         if not steps or steps[0] < 1:
             raise ValueError(f"batch_steps must be positive, got {steps}")

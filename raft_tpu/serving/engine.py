@@ -16,13 +16,14 @@ count by ServeConfig construction.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from ..config import RAFTConfig
+from ..config import RAFTConfig, adaptive_iters
 from ..telemetry.log import get_logger
 from .config import ServeConfig
 
@@ -30,15 +31,27 @@ _log = get_logger("serve")
 
 
 class InferenceEngine:
-    """(bucket, batch) -> compiled executable, with hit/miss accounting."""
+    """(bucket, batch, iters-policy) -> compiled executable, with hit/miss
+    accounting.  With ``iters_policy='converge:...'`` (ServeConfig override
+    or model-config default) every executable returns (flow, iters_used):
+    per-sample early exit runs INSIDE the compiled while_loop, so shapes —
+    and therefore the warm compile grid — never change with the data."""
 
     def __init__(self, config: RAFTConfig, params, sconfig: ServeConfig,
                  iters: Optional[int] = None):
         import jax
 
+        if sconfig.iters_policy is not None:
+            # the serving tier declares its compute policy up front, like
+            # its buckets and batch steps; it overrides the model config so
+            # warmup compiles exactly what serve time executes
+            config = dataclasses.replace(config,
+                                         iters_policy=sconfig.iters_policy)
         self.config = config
         self.sconfig = sconfig
         self.iters = iters
+        self.iters_policy = config.iters_policy
+        self.adaptive = adaptive_iters(config.iters_policy)
         self.params = jax.tree.map(jax.numpy.asarray, params)
         self._mesh = None
         if sconfig.dp_devices > 1:
@@ -49,27 +62,38 @@ class InferenceEngine:
                     f"dp_devices={sconfig.dp_devices} but only "
                     f"{len(jax.devices())} device(s) visible")
             self._mesh = make_mesh(sconfig.dp_devices)
-            self._fn = make_dp_eval_fn(config, self._mesh, iters=iters)
+            self._fn = make_dp_eval_fn(config, self._mesh, iters=iters,
+                                       with_iters=self.adaptive)
         else:
-            from ..models.raft import make_inference_fn
-            self._fn = jax.jit(make_inference_fn(config, iters=iters))
+            from ..models.raft import (make_counted_inference_fn,
+                                       make_inference_fn)
+            make = (make_counted_inference_fn if self.adaptive
+                    else make_inference_fn)
+            self._fn = jax.jit(make(config, iters=iters))
         self._lock = threading.Lock()
-        self._exec: Dict[Tuple[int, int, int], object] = {}
+        self._exec: Dict[Tuple[int, int, int, str], object] = {}
         self.compile_hits = 0
         self.compile_misses = 0
         self.warmup_seconds = 0.0
 
     # -- compile-cache bookkeeping ---------------------------------------
 
-    def _compile(self, key: Tuple[int, int, int]):
+    def _key(self, h: int, w: int, b: int) -> Tuple[int, int, int, str]:
+        """Engine-cache key: the iteration policy rides along with the
+        shape, so an executable can never be reused under a different
+        compute policy than it was warmed with (and stays warm across
+        every difficulty mix — early exit is inside the executable)."""
+        return (h, w, b, self.iters_policy)
+
+    def _compile(self, key: Tuple[int, int, int, str]):
         import jax
         import jax.numpy as jnp
 
-        h, w, b = key
+        h, w, b = key[:3]
         spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
         return self._fn.lower(self.params, spec, spec).compile()
 
-    def _get_executable(self, key: Tuple[int, int, int]):
+    def _get_executable(self, key: Tuple[int, int, int, str]):
         with self._lock:
             ex = self._exec.get(key)
             if ex is not None:
@@ -93,7 +117,7 @@ class InferenceEngine:
         n = 0
         for (h, w) in self.sconfig.buckets:
             for b in self.sconfig.batch_steps:
-                key = (h, w, b)
+                key = self._key(h, w, b)
                 with self._lock:
                     if key in self._exec:
                         continue
@@ -119,11 +143,16 @@ class InferenceEngine:
     # -- the device call --------------------------------------------------
 
     def run(self, bucket: Tuple[int, int], im1: np.ndarray,
-            im2: np.ndarray) -> np.ndarray:
+            im2: np.ndarray):
         """[n, BH, BW, 3] float32 pair -> [n, BH, BW, 2] float32 flow.
-        ``n`` must be a declared batch step (the batcher pads to one)."""
+        ``n`` must be a declared batch step (the batcher pads to one).
+        Under a converge policy returns (flow, iters_used [n] int32) —
+        the batcher passes per-row counts through to each request."""
         h, w = bucket
         n = im1.shape[0]
-        ex = self._get_executable((h, w, n))
-        flow = ex(self.params, im1, im2)
-        return np.asarray(flow)
+        ex = self._get_executable(self._key(h, w, n))
+        out = ex(self.params, im1, im2)
+        if self.adaptive:
+            flow, iters_used = out
+            return np.asarray(flow), np.asarray(iters_used)
+        return np.asarray(out)

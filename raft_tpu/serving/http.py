@@ -137,6 +137,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "status": "ok",
                     "buckets": [list(b) for b in app.sconfig.buckets],
                     "batch_steps": list(app.sconfig.batch_steps),
+                    "iters_policy": getattr(app.engine, "iters_policy",
+                                            "fixed"),
                     "queue_depth": len(app.queue),
                     "executables": app.engine_executables(),
                 })
@@ -193,6 +195,8 @@ class _Handler(BaseHTTPRequestHandler):
             "batch_real": req.batch_real,
             "batch_padded": req.batch_padded,
         }
+        if req.iters_used is not None:     # converge policy: compute spent
+            meta["iters_used"] = req.iters_used
         if "application/octet-stream" in (self.headers.get("Accept") or ""):
             buf = io.BytesIO()
             np.savez(buf, flow=req.result,

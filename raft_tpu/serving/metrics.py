@@ -17,7 +17,8 @@ from typing import Dict
 
 from ..telemetry.registry import (_Metric,  # noqa: F401 — compat re-export
                                   Counter, Gauge, Histogram, Registry,
-                                  DEFAULT_LATENCY_BUCKETS, _fmt)
+                                  DEFAULT_LATENCY_BUCKETS,
+                                  ITERS_USED_BUCKETS, _fmt)
 
 
 def make_serving_metrics(registry: Registry, config,
@@ -67,4 +68,17 @@ def make_serving_metrics(registry: Registry, config,
             "raft_serving_compile_cache_misses_total",
             "Device calls that had to compile (0 after warmup = the "
             "no-recompile-storm guarantee)"),
+        "iters_used": (iters_used := registry.histogram(
+            "raft_iters_used",
+            "GRU iterations spent per request — fills only under "
+            "--iters-policy converge:* (per-sample early exit); stays "
+            "empty under 'fixed', where every request costs the declared "
+            "count",
+            buckets=ITERS_USED_BUCKETS)),
+        # live mean over everything observed so far: sum/count of the
+        # histogram, sampled at scrape time — never goes stale
+        "iters_mean": registry.gauge(
+            "raft_iters_mean",
+            "Mean GRU iterations per request (adaptive-compute saving)",
+            fn=iters_used.mean),
     }

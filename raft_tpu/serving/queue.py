@@ -52,7 +52,7 @@ class Request:
 
     __slots__ = ("id", "image1", "image2", "bucket", "pads", "deadline",
                  "enqueued_at", "dequeued_at", "_done", "result", "error",
-                 "batch_real", "batch_padded")
+                 "batch_real", "batch_padded", "iters_used")
 
     def __init__(self, image1: np.ndarray, image2: np.ndarray,
                  bucket: Tuple[int, int], pads: Tuple[int, int, int, int],
@@ -70,6 +70,9 @@ class Request:
         self.error: Optional[BaseException] = None
         self.batch_real = 0
         self.batch_padded = 0
+        # GRU iterations this request's sample actually spent (set by the
+        # batcher under --iters-policy converge:*; None under 'fixed')
+        self.iters_used: Optional[int] = None
 
     def resolve(self, flow: np.ndarray) -> None:
         self.result = flow

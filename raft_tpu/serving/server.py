@@ -223,7 +223,8 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
             default_deadline_ms=args.deadline_ms,
             host=args.host, port=args.port,
             dp_devices=args.serve_dp or 1,
-            warmup=not args.no_warmup)
+            warmup=not args.no_warmup,
+            iters_policy=getattr(args, "iters_policy", None))
     except ValueError as e:
         print(f"ERROR: {e}")
         return 2
@@ -240,6 +241,7 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
           f"batch_steps={list(sconfig.batch_steps)}  "
           f"max_wait={sconfig.max_wait_ms}ms  "
           f"queue_depth={sconfig.queue_depth}  "
+          f"iters_policy={server.engine.iters_policy}  "
           f"({time.monotonic() - t0:.1f}s to ready)")
     print(f"[serve] POST {server.url}/v1/flow   "
           f"GET {server.url}/healthz   GET {server.url}/metrics")

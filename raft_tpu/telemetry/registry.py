@@ -164,6 +164,13 @@ class Gauge(_Metric):
 DEFAULT_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                            0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+# GRU-iteration buckets for the ``raft_iters_used`` histogram (the
+# adaptive-compute observable, OBSERVABILITY.md): integer-valued samples in
+# 1..max_iters, bucketed to resolve both the small-iters regime (early
+# exits under iters_policy='converge:...') and the fixed 12/32 defaults.
+ITERS_USED_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 20.0,
+                      24.0, 32.0, 48.0, 64.0)
+
 
 class Histogram(_Metric):
     kind = "histogram"

@@ -35,15 +35,17 @@ _log = get_logger("val")
 
 
 @functools.lru_cache(maxsize=8)
-def _jitted_eval_fn(config: RAFTConfig, iters, warm: bool):
+def _jitted_eval_fn(config: RAFTConfig, iters, warm: bool,
+                    counted: bool = False):
     """Cache the jitted eval executables across evaluate_dataset calls
     (RAFTConfig is a frozen, hashable dataclass).  Without this every call
     builds a fresh closure with its own empty jit cache, so periodic evals
     in the training loop — and back-to-back benchmark runs — pay a full XLA
-    recompile each time."""
+    recompile each time.  ``counted`` appends the per-sample iters_used
+    output (iters_policy='converge:...' telemetry)."""
     from .step import make_warm_eval_step
     make = make_warm_eval_step if warm else make_eval_step
-    return jax.jit(make(config, iters=iters))
+    return jax.jit(make(config, iters=iters, with_iters=counted))
 
 
 def _gt_canvas(flow_gt: np.ndarray, valid: np.ndarray, pads, hw):
@@ -115,7 +117,19 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
     if weighting not in ("sample", "pixel"):
         raise ValueError(f"weighting must be 'sample' or 'pixel', "
                          f"got {weighting!r}")
-    eval_fn = _jitted_eval_fn(config, iters, warm=False)
+    from ..config import adaptive_iters
+    from ..telemetry.registry import ITERS_USED_BUCKETS, default_registry
+    adaptive = adaptive_iters(config.iters_policy)
+    iters_hist = None
+    iters_sum = [0.0, 0]                       # (sum, count) over samples
+    if adaptive:
+        # per-request iterations-used histogram on the process registry —
+        # the same raft_iters_used family /metrics and tlm summary read
+        iters_hist = default_registry().get_or_histogram(
+            "raft_iters_used",
+            "GRU iterations spent per sample (converge early-exit policy)",
+            buckets=ITERS_USED_BUCKETS)
+    eval_fn = _jitted_eval_fn(config, iters, warm=False, counted=adaptive)
     # Batched, jitted metric reduction: per-sample valid-masked SUMS (vmap of
     # the same epe_metrics the per-sample path used), so a flush group costs
     # ONE device call and ONE device_get regardless of batch size — no
@@ -195,6 +209,12 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
                                log_fn=_log.info if verbose else None)
     flushes = 0
 
+    def account_iters(iters_dev):
+        for v in np.asarray(iters_dev):
+            iters_hist.observe(float(v))
+            iters_sum[0] += float(v)
+            iters_sum[1] += 1
+
     def flush(group):
         # record the executable's ACTUAL input shape (batch included): with
         # batching, a shape group costs one compile per distinct flush size
@@ -207,6 +227,9 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
             flows_dev = eval_fn(
                 params, jnp.asarray(np.concatenate([g[0] for g in group])),
                 jnp.asarray(np.concatenate([g[1] for g in group])))
+        if adaptive:
+            flows_dev, iters_dev = flows_dev
+            account_iters(iters_dev)
         account(flows_dev, group)
 
     try:
@@ -225,7 +248,8 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
                 raise ValueError(
                     "warm_start needs a dataset with scene structure "
                     "(is_scene_start), e.g. MpiSintel")
-            warm_fn = _jitted_eval_fn(config, iters, warm=True)
+            warm_fn = _jitted_eval_fn(config, iters, warm=True,
+                                      counted=adaptive)
 
             # The seed dependency (frame t's DEVICE output feeds frame t+1's
             # host-side forward_interpolate) makes the compute chain strictly
@@ -257,10 +281,14 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
                         else:
                             init = forward_interpolate(prev_lr[0])[None]
                         with stage("val/forward"):
-                            flow_dev, lr_dev = warm_fn(params,
-                                                       jnp.asarray(im1p),
-                                                       jnp.asarray(im2p),
-                                                       jnp.asarray(init))
+                            res = warm_fn(params, jnp.asarray(im1p),
+                                          jnp.asarray(im2p),
+                                          jnp.asarray(init))
+                        if adaptive:
+                            flow_dev, lr_dev, iters_dev = res
+                            account_iters(iters_dev)
+                        else:
+                            flow_dev, lr_dev = res
                         prev_lr = np.asarray(lr_dev)
                         account(flow_dev,
                                 [(im1p, im2p, pads, flow_gt, valid, idx)])
@@ -294,6 +322,10 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
         out = {k: v / max(count, 1) for k, v in sums.items()}
     out["samples"] = count
     out["seconds"] = time.time() - t0
+    if adaptive and iters_sum[1]:
+        # mean GRU iterations actually spent — the adaptive-compute saving
+        # next to the epe it cost (full distribution: raft_iters_used)
+        out["mean_iters"] = iters_sum[0] / iters_sum[1]
     # one XLA compile per distinct EXECUTABLE input shape, batch included
     # (per padded shape: its full-batch size plus at most one remainder
     # size) — the observable the bucketing exists to bound (and what tests

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from ..config import RAFTConfig, TrainConfig
+from ..config import RAFTConfig, TrainConfig, adaptive_iters
 from ..lint.contracts import contract
 from ..models.raft import raft_forward
 from .loss import sequence_loss
@@ -44,6 +44,8 @@ def make_train_step(config: RAFTConfig, tconfig: TrainConfig,
     through the micro-batches.
     """
 
+    adaptive = adaptive_iters(config.iters_policy)
+
     def grad_fn(trainable, bn_state, batch: Batch, rng: jax.Array):
         def loss_fn(trainable):
             params = merge_bn_state(trainable, bn_state)
@@ -55,6 +57,12 @@ def make_train_step(config: RAFTConfig, tconfig: TrainConfig,
                 out.flow_iters, batch.flow, batch.valid,
                 gamma=tconfig.gamma, max_flow=tconfig.max_flow,
                 normalization=tconfig.loss_normalization)
+            if adaptive:
+                # mean GRU iterations actually spent per sample (masked
+                # scan: frozen samples stop counting) — streams into
+                # metrics.jsonl so converge-policy training is observable
+                metrics["mean_iters"] = jax.lax.stop_gradient(
+                    out.iters_used.astype(jnp.float32).mean())
             _, new_bn = split_bn_state(new_params)
             return loss, (new_bn, metrics)
 
@@ -117,8 +125,17 @@ def make_train_step(config: RAFTConfig, tconfig: TrainConfig,
     return train_step
 
 
-def make_eval_step(config: RAFTConfig, iters: Optional[int] = None):
-    """Returns step(params, image1, image2) -> final full-res flow."""
+def make_eval_step(config: RAFTConfig, iters: Optional[int] = None,
+                   with_iters: bool = False):
+    """Returns step(params, image1, image2) -> final full-res flow, or —
+    with ``with_iters`` — (flow, iters_used [B] int32): the per-sample GRU
+    iteration count the converge policy's telemetry reports."""
+
+    @contract(image1="*[B,H,W,3]", image2="*[B,H,W,3]")
+    def counted_step(params, image1, image2):
+        out, _ = raft_forward(params, image1, image2, config, iters=iters,
+                              train=False, all_flows=False)
+        return out.flow, out.iters_used
 
     @contract(image1="*[B,H,W,3]", image2="*[B,H,W,3]",
               _returns="*[B,H,W,2]")
@@ -127,16 +144,19 @@ def make_eval_step(config: RAFTConfig, iters: Optional[int] = None):
                               train=False, all_flows=False)
         return out.flow
 
-    return eval_step
+    return counted_step if with_iters else eval_step
 
 
-def make_warm_eval_step(config: RAFTConfig, iters: Optional[int] = None):
+def make_warm_eval_step(config: RAFTConfig, iters: Optional[int] = None,
+                        with_iters: bool = False):
     """Returns step(params, image1, image2, flow_init) ->
     (full-res flow, low-res flow) — the official Sintel warm-start
     evaluation step: ``flow_init`` (1/8 resolution; zeros = cold start,
     identical to no init) seeds the recurrence, and the returned low-res
     flow is forward-projected (utils.frame_utils.forward_interpolate) to
-    seed the next frame of the same scene."""
+    seed the next frame of the same scene.  ``with_iters`` appends the
+    per-sample iteration count (warm-started frames exit earliest — the
+    composition tools/warmstart_bench.py measures)."""
 
     @contract(image1="*[B,H,W,3]", image2="*[B,H,W,3]",
               flow_init="*[B,HL,WL,2]")
@@ -144,6 +164,8 @@ def make_warm_eval_step(config: RAFTConfig, iters: Optional[int] = None):
         out, _ = raft_forward(params, image1, image2, config, iters=iters,
                               train=False, all_flows=False,
                               flow_init=flow_init)
+        if with_iters:
+            return out.flow, out.flow_lr, out.iters_used
         return out.flow, out.flow_lr
 
     return eval_step

@@ -262,3 +262,25 @@ def test_val_submission_export_pins_float32(monkeypatch, capsys):
     assert cli._make_config(
         make_args(split="testing", dump_flow="d",
                   dtype="bfloat16")).compute_dtype == "bfloat16"
+
+
+def test_iters_policy_flag_validation(capsys):
+    """A typo'd --iters-policy must exit 2 at parse time (argparse type
+    hook), and a valid spec lands in the model config."""
+    from raft_tpu import cli
+
+    with pytest.raises(SystemExit) as ei:
+        cli.main(["-m", "test", "--iters-policy", "convrge:1e-2"])
+    assert ei.value.code == 2
+    assert "iters_policy" in capsys.readouterr().err
+
+    import argparse
+    args = argparse.Namespace(mode="test", dtype="float32",
+                              corr_impl="dense", ctx_hoist=None,
+                              corr_lookup=None, iters=None, small=True,
+                              iters_policy="converge:0.5:2")
+    cfg = cli._make_config(args)
+    assert cfg.iters_policy == "converge:0.5:2"
+    # absent flag (older programmatic callers): config default 'fixed'
+    del args.iters_policy
+    assert cli._make_config(args).iters_policy == "fixed"

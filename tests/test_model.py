@@ -204,6 +204,153 @@ def test_gru_ctx_hoist_bfloat16():
     assert np.all(np.isfinite(np.asarray(out.flow)))
 
 
+# ------------------------------------------- adaptive compute (round 8) --
+
+def test_iters_policy_parse():
+    from raft_tpu.config import parse_iters_policy
+    assert parse_iters_policy("fixed") == ("fixed", None, None)
+    assert parse_iters_policy("converge:1e-2") == ("converge", 1e-2, 1)
+    assert parse_iters_policy("converge:0.5:4") == ("converge", 0.5, 4)
+    for bad in ("convrge:1e-2", "converge", "converge:xyz",
+                "converge:-1", "converge:nan", "converge:1e-2:0",
+                "converge:1e-2:two", "converge:1:2:3"):
+        with pytest.raises(ValueError, match="iters_policy"):
+            parse_iters_policy(bad)
+
+
+def test_iters_policy_typo_raises_in_forward():
+    cfg = RAFTConfig.small_model(iters=1, iters_policy="converge")
+    params = init_raft(jax.random.PRNGKey(0), cfg)
+    im = jnp.zeros((1, 32, 32, 3))
+    with pytest.raises(ValueError, match="iters_policy"):
+        raft_forward(params, im, im, cfg)
+
+
+def test_converge_zero_matches_fixed_bitwise():
+    """converge:0 never triggers (a norm is never < 0): both the masked
+    scan and the while-loop fast path must reproduce 'fixed' BIT-FOR-BIT
+    (same ops on every sample, the masks all-true)."""
+    fixed = RAFTConfig.small_model(iters=4)
+    conv = RAFTConfig.small_model(iters=4, iters_policy="converge:0")
+    params, im1, im2 = _params_and_images(fixed, B=2, H=32, W=48)
+    # inference: fixed scan vs the adaptive while_loop
+    out_f, _ = raft_forward(params, im1, im2, fixed)
+    out_c, _ = raft_forward(params, im1, im2, conv)
+    assert np.array_equal(np.asarray(out_f.flow), np.asarray(out_c.flow))
+    assert np.asarray(out_c.iters_used).tolist() == [4, 4]
+    assert np.asarray(out_f.iters_used).tolist() == [4, 4]
+    # train path: plain scan vs masked scan
+    out_ft, _ = raft_forward(params, im1, im2, fixed, train=True)
+    out_ct, _ = raft_forward(params, im1, im2, conv, train=True)
+    assert np.array_equal(np.asarray(out_ft.flow_iters),
+                          np.asarray(out_ct.flow_iters))
+
+
+def test_converge_freeze_repeats_frozen_flow():
+    """Once a sample converges, every later flow_iters entry must repeat
+    its frozen flow exactly — the sequence loss and --dump-flow contract.
+    eps=1e9 with min_iters=2 freezes everything right after iteration 2."""
+    cfg = RAFTConfig.small_model(iters=5, iters_policy="converge:1e9:2")
+    params, im1, im2 = _params_and_images(cfg, B=2, H=32, W=48)
+    out, _ = raft_forward(params, im1, im2, cfg, all_flows=True)
+    fi = np.asarray(out.flow_iters)
+    assert np.asarray(out.iters_used).tolist() == [2, 2]
+    for t in range(2, 5):
+        assert np.array_equal(fi[t], fi[1]), t
+    # the pre-freeze prefix is the same computation as 'fixed'
+    ref, _ = raft_forward(params, im1, im2, RAFTConfig.small_model(iters=5),
+                          all_flows=True)
+    assert np.array_equal(fi[:2], np.asarray(ref.flow_iters)[:2])
+
+
+def test_converge_per_sample_freeze_mixed_batch():
+    """Easy + hard pair in ONE batch: with eps between the two samples'
+    first-iteration update norms, the easy sample freezes after iteration
+    1 while the hard one keeps iterating — and (small variant: per-sample
+    normalization only) the hard sample's trajectory is untouched by its
+    frozen batch-mate."""
+    fixed = RAFTConfig.small_model(iters=5)
+    params, im1, im2 = _params_and_images(fixed, B=2, H=32, W=48)
+    # measure each sample's first-iteration ‖Δflow‖ at the 1/8 grid, then
+    # pick eps strictly between them — deterministic mixed difficulty
+    # without assuming anything about the random-weight dynamics
+    probe, _ = raft_forward(params, im1, im2, fixed, iters=1)
+    dn = np.linalg.norm(np.asarray(probe.flow_lr), axis=-1).mean(axis=(1, 2))
+    lo, hi = sorted(dn)
+    assert lo < hi                      # distinct inputs -> distinct norms
+    eps = float(np.sqrt(lo * hi))
+    easy = int(np.argmin(dn))
+    cfg = RAFTConfig.small_model(iters=5, iters_policy=f"converge:{eps!r}")
+    out, _ = raft_forward(params, im1, im2, cfg, all_flows=True)
+    used = np.asarray(out.iters_used)
+    assert used[easy] == 1
+    assert used[1 - easy] >= 2
+    fi = np.asarray(out.flow_iters)
+    for t in range(1, 5):               # frozen sample repeats its flow
+        assert np.array_equal(fi[t, easy], fi[0, easy]), t
+    # the active sample's trajectory matches a run without the frozen mate
+    # (small variant: per-sample normalization only; compare relative to
+    # flow scale — batch-1 vs batch-2 convs reassociate fp32 reductions)
+    hard = 1 - easy
+    solo, _ = raft_forward(params, im1[hard:hard + 1],
+                           im2[hard:hard + 1], cfg, all_flows=True)
+    a = fi[:, hard]
+    b = np.asarray(solo.flow_iters)[:, 0]
+    scale = max(np.abs(a).mean(), 1e-3)
+    assert np.abs(a - b).max() / scale < 1e-3
+    # the while-loop fast path agrees with the masked scan, per sample
+    out_w, _ = raft_forward(params, im1, im2, cfg)
+    assert np.asarray(out_w.iters_used).tolist() == used.tolist()
+    np.testing.assert_allclose(np.asarray(out_w.flow), fi[-1],
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_converge_gradients_flow_through_masked_scan_remat():
+    """Gradient must flow through the masked scan (frozen samples simply
+    contribute zero past their exit), composing with remat_iters."""
+    cfg = RAFTConfig.small_model(iters=3, iters_policy="converge:1e9:2",
+                                 remat_iters=True)
+    params, im1, im2 = _params_and_images(cfg, B=2, H=16, W=24)
+
+    def loss(p):
+        out, _ = raft_forward(p, im1, im2, cfg, train=True)
+        return jnp.abs(out.flow_iters).mean()
+
+    grads = jax.grad(loss)(params)
+    leaves = [np.asarray(g) for g in jax.tree.leaves(grads)]
+    assert all(np.isfinite(g).all() for g in leaves)
+    gnorm = float(jnp.linalg.norm(
+        grads["update_block"]["flow_head"]["conv2"]["w"]))
+    assert gnorm > 0.0
+
+
+def test_converge_jit_and_counted_fn():
+    """The counted inference fn jits, and under jit the early exit still
+    reports per-sample counts (static shapes, data-dependent trip count)."""
+    from raft_tpu.models import make_counted_inference_fn
+    cfg = RAFTConfig.small_model(iters=4, iters_policy="converge:1e9:2")
+    params, im1, im2 = _params_and_images(cfg, B=2, H=32, W=48)
+    flow, used = jax.jit(make_counted_inference_fn(cfg))(params, im1, im2)
+    assert flow.shape == (2, 32, 48, 2)
+    assert used.dtype == jnp.int32
+    assert np.asarray(used).tolist() == [2, 2]
+    # fixed policy reports the declared count
+    flowf, usedf = make_counted_inference_fn(
+        RAFTConfig.small_model(iters=4))(params, im1, im2)
+    assert np.asarray(usedf).tolist() == [4, 4]
+
+
+def test_converge_spatial_sharding_rejected():
+    """Per-sample ‖Δflow‖ on a row shard sees only the local slab —
+    adaptive + spatial must raise, not silently diverge across shards."""
+    from raft_tpu.ops import spmd
+    cfg = RAFTConfig.small_model(iters=2, iters_policy="converge:1e-2")
+    params, im1, im2 = _params_and_images(cfg, H=32, W=48)
+    with spmd.spatial_sharding("spatial"):
+        with pytest.raises(NotImplementedError, match="converge"):
+            raft_forward(params, im1, im2, cfg)
+
+
 def test_scan_unroll_equivalence():
     """scan_unroll is a pure scheduling knob: outputs must match unroll=1."""
     base = RAFTConfig.full(iters=4)

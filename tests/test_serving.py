@@ -314,9 +314,11 @@ def _post_json(server, im1, im2, deadline_ms=None):
 def test_live_warmup_compiled_one_executable_per_bucket(live_server):
     server, _, _ = live_server
     eng = server.engine
-    # 2 buckets x 1 batch step: exactly one warm executable per bucket
+    # 2 buckets x 1 batch step: exactly one warm executable per bucket;
+    # the iters policy rides in the cache key (an executable can never be
+    # reused under a different compute policy than it was warmed with)
     assert eng.executables == 2
-    assert eng.keys() == [(32, 48, 2), (64, 96, 2)]
+    assert eng.keys() == [(32, 48, 2, "fixed"), (64, 96, 2, "fixed")]
     assert eng.compile_misses == 0
 
 
@@ -476,6 +478,101 @@ def test_http_engine_failure_returns_500_not_dropped_socket():
             text = r.read().decode()
         assert 'raft_serving_requests_total{status="error"} 1' in text
         assert "raft_serving_queue_depth 0" in text   # live callback gauge
+    finally:
+        server.stop()
+
+
+# ------------------------------------------- adaptive-compute (converge) --
+
+class CountingStubEngine(StubEngine):
+    """Converge-policy engine shape: returns (flows, per-row iters_used)."""
+
+    iters_policy = "converge:1e-2"
+
+    def run(self, bucket, im1, im2):
+        flows = super().run(bucket, im1, im2)
+        n = im1.shape[0]
+        # per-row counts 3, 4, 5, ... — distinct so slicing bugs show
+        return flows, np.arange(3, 3 + n, dtype=np.int32)
+
+
+def test_batcher_passes_iters_used_through():
+    """A (flows, iters_used) engine return lands per-REQUEST counts on the
+    request objects and in the raft_iters_used histogram — padding rows
+    are never observed."""
+    from raft_tpu.serving.metrics import make_serving_metrics
+
+    eng = CountingStubEngine()
+    q = RequestQueue(16)
+    reg = Registry()
+    sc = ServeConfig(buckets=(BUCKET,), max_batch=4, batch_steps=(4,),
+                     max_wait_ms=20.0)
+    metrics = make_serving_metrics(reg, sc)
+    b = MicroBatcher(q, eng.run, sc.pad_batch_to, 4, 20.0, metrics=metrics)
+    b.start()
+    reqs = [make_request() for _ in range(3)]      # 3 real rows, padded to 4
+    for r in reqs:
+        q.submit(r)
+    for r in reqs:
+        r.wait(timeout=10)
+    assert [r.iters_used for r in reqs] == [3, 4, 5]
+    hist = reg.get("raft_iters_used")
+    assert hist.count == 3                          # padding row NOT counted
+    assert hist.sum == 3 + 4 + 5
+    # the mean gauge is live (sum/count of the histogram)
+    assert abs(reg.get("raft_iters_mean").value - 4.0) < 1e-9
+    q.close()
+    b.join(5)
+
+
+def test_plain_engine_leaves_iters_used_unset():
+    eng = StubEngine()
+    q, b = make_stub_stack(eng, max_batch=2, max_wait_ms=5.0)
+    r = make_request()
+    q.submit(r)
+    r.wait(timeout=10)
+    assert r.iters_used is None
+    q.close()
+    b.join(5)
+
+
+def test_serve_config_iters_policy_validated():
+    with pytest.raises(ValueError, match="iters_policy"):
+        ServeConfig(iters_policy="convrge:1e-2")
+    sc = ServeConfig(iters_policy="converge:1e-2:3")
+    assert sc.iters_policy == "converge:1e-2:3"
+
+
+def test_live_converge_policy_end_to_end():
+    """A live server under --iters-policy converge:*: warmup pins the
+    policy-keyed executables, a request reports its iterations in the
+    response meta and the raft_iters_used/raft_iters_mean families, and
+    nothing recompiles."""
+    from raft_tpu.config import RAFTConfig, init_rng
+    from raft_tpu.models import init_raft
+
+    config = RAFTConfig.small_model(iters=3)
+    params = init_raft(init_rng(), config)
+    # eps=1e9: every sample converges right after min_iters=2 — the
+    # deterministic early exit (random weights never reach a small eps)
+    sconfig = ServeConfig(buckets=((32, 48),), max_batch=1,
+                          batch_steps=(1,), max_wait_ms=5.0, queue_depth=8,
+                          port=0, iters_policy="converge:1e9:2")
+    server = FlowServer(config, params, sconfig)
+    server.start()
+    try:
+        assert server.engine.keys() == [(32, 48, 1, "converge:1e9:2")]
+        rng = np.random.RandomState(7)
+        im = rng.rand(32, 48, 3).astype(np.float32)
+        resp = _post_json(server, im, im)
+        assert resp["meta"]["iters_used"] == 2          # exited at min_iters
+        with urllib.request.urlopen(server.url + "/healthz") as r:
+            assert json.loads(r.read())["iters_policy"] == "converge:1e9:2"
+        with urllib.request.urlopen(server.url + "/metrics") as r:
+            text = r.read().decode()
+        assert "raft_iters_used_count 1" in text
+        assert "raft_iters_mean 2" in text
+        assert server.engine.compile_misses == 0
     finally:
         server.stop()
 

@@ -53,6 +53,22 @@ def main() -> int:
     p.add_argument("--skip-exec", action="store_true",
                    help="memory analysis + loader only (no executed step)")
     p.add_argument("--skip-loader", action="store_true")
+    # iters-policy envelope (round 8): EPE under converge:* vs fixed-32
+    p.add_argument("--skip-policy", action="store_true",
+                   help="skip the converge-policy EPE envelope stage")
+    p.add_argument("--policy-steps", type=int, default=300, metavar="N",
+                   help="training steps for the small synthetic model the "
+                        "policy stage evaluates (0 = random weights: "
+                        "early exit never triggers, stage is vacuous)")
+    p.add_argument("--policy-ckpt", default=None, metavar="NPZ",
+                   help="reuse a trained raft-small checkpoint instead of "
+                        "training in-process")
+    p.add_argument("--policy-eps", default="1e-2,1e-3,0.8",
+                   help="comma list of converge eps values to check")
+    p.add_argument("--epe-envelope", type=float, default=0.25,
+                   help="max allowed EPE regression of a TRIGGERED "
+                        "converge arm vs fixed-32 (signed: improvements "
+                        "always pass)")
     p.add_argument("--out", default=None, metavar="FILE")
     args = p.parse_args()
 
@@ -164,7 +180,99 @@ def main() -> int:
         res = loader_run(samples=24, workers=(2, 4), crop=(H, W))
         res["stage"] = "loader"
         _emit(res, args.out)
+
+    # -- 4. converge-policy EPE envelope (round 8) ------------------------
+    if not args.skip_policy:
+        return _policy_envelope(args)
     return 0
+
+
+def _policy_envelope(args) -> int:
+    """EPE under --iters-policy converge:* vs fixed-32, on a briefly
+    trained raft-small synthetic model (random weights never reach any
+    useful eps — the update norm has to have LEARNED to shrink).  A
+    triggered arm (mean_iters < 32) must hold EPE within --epe-envelope of
+    the fixed-32 baseline; improvements always pass (the toy model over-
+    iterates past its training horizon, so early exit can help EPE)."""
+    import dataclasses
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.data.synthetic import SyntheticFlowDataset
+    from raft_tpu.models import init_raft
+    from raft_tpu.training import Batch, TrainState, make_optimizer, \
+        make_train_step
+    from raft_tpu.training.evaluate import evaluate_dataset
+
+    config = RAFTConfig.small_model(iters=8)       # demo-train recipe
+    if args.policy_ckpt:
+        from raft_tpu.convert import load_checkpoint_auto
+        params = jax.tree.map(jnp.asarray,
+                              load_checkpoint_auto(args.policy_ckpt))
+        trained = f"ckpt:{args.policy_ckpt}"
+    else:
+        params = init_raft(jax.random.PRNGKey(0), config)
+        trained = f"steps:{args.policy_steps}"
+        if args.policy_steps:
+            t = TrainConfig.for_stage("synthetic", lr=2e-4,
+                                      num_steps=args.policy_steps)
+            tx = make_optimizer(t)
+            state = TrainState.create(params, tx)
+            step = jax.jit(make_train_step(config, t, tx), donate_argnums=0)
+            ds = SyntheticFlowDataset(size=t.image_size, length=512, seed=0)
+            t0 = time.perf_counter()
+            rng = np.random.RandomState(0)
+            for i in range(args.policy_steps):
+                idx = rng.randint(0, len(ds), t.batch_size)
+                s = [ds[j] for j in idx]
+                batch = Batch(
+                    image1=jnp.asarray(np.stack([x[0] for x in s])),
+                    image2=jnp.asarray(np.stack([x[1] for x in s])),
+                    flow=jnp.asarray(np.stack([x[2] for x in s])),
+                    valid=jnp.asarray(np.stack([x[3] for x in s])))
+                state, metrics = step(state, batch,
+                                      jax.random.fold_in(
+                                          jax.random.PRNGKey(1), i))
+            loss = float(np.asarray(metrics["loss"]))
+            from raft_tpu.training.state import merge_bn_state
+            params = merge_bn_state(state.params, state.bn_state)
+            _emit({"stage": "policy_train", "steps": args.policy_steps,
+                   "final_loss": round(loss, 3),
+                   "seconds": round(time.perf_counter() - t0, 1)}, args.out)
+
+    held_out = SyntheticFlowDataset(size=(96, 128), length=16, seed=9001)
+    eval_cfg = dataclasses.replace(config, iters=32)
+    fixed = evaluate_dataset(params, eval_cfg, held_out, batch_size=4,
+                             verbose=False)
+    rows, violations, triggered = [], [], 0
+    for eps in [e.strip() for e in args.policy_eps.split(",") if e.strip()]:
+        ccfg = dataclasses.replace(eval_cfg, iters_policy=f"converge:{eps}")
+        m = evaluate_dataset(params, ccfg, held_out, batch_size=4,
+                             verbose=False)
+        mean_iters = m.get("mean_iters", 32.0)
+        delta = m["epe"] - fixed["epe"]
+        fired = mean_iters < 31.999
+        ok = (not fired) or delta <= args.epe_envelope
+        if fired:
+            triggered += 1
+        if not ok:
+            violations.append(f"converge:{eps}: epe +{delta:.4f} "
+                              f"> envelope {args.epe_envelope}")
+        rows.append({"policy": f"converge:{eps}",
+                     "epe": round(m["epe"], 4),
+                     "epe_delta_vs_fixed32": round(delta, 4),
+                     "mean_iters": round(mean_iters, 3),
+                     "triggered": fired, "within_envelope": ok})
+    _emit({"stage": "iters_policy_envelope", "model": trained,
+           "epe_envelope": args.epe_envelope,
+           "fixed32_epe": round(fixed["epe"], 4), "rows": rows,
+           "arms_triggered": triggered,
+           "ok": not violations,
+           "violations": violations or None}, args.out)
+    return 1 if violations else 0
 
 
 if __name__ == "__main__":

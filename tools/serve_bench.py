@@ -51,6 +51,26 @@ def parse_prom(text: str):
     return out
 
 
+def hist_percentile(prom, name: str, q: float):
+    """Percentile from a scraped histogram's cumulative buckets: the upper
+    bound of the first bucket covering quantile ``q`` (exact for integer-
+    valued samples like raft_iters_used whose buckets sit on integers)."""
+    pts = []
+    for k, v in prom.items():
+        m = re.match(rf'^{re.escape(name)}_bucket\{{le="([^"]+)"\}}$', k)
+        if m:
+            le = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+            pts.append((le, v))
+    total = prom.get(f"{name}_count", 0)
+    if not pts or not total:
+        return None
+    pts.sort()
+    for le, cum in pts:
+        if cum >= q * total:
+            return le
+    return pts[-1][0]
+
+
 class Client:
     """One keep-alive connection + the shared accounting."""
 
@@ -157,6 +177,11 @@ def main() -> int:
     p.add_argument("--deadline-ms", type=float, default=10000.0)
     p.add_argument("--small", action="store_true", default=None)
     p.add_argument("--iters", type=int, default=None)
+    p.add_argument("--iters-policy", default=None, metavar="POLICY",
+                   help="serve under an iteration policy ('fixed' or "
+                        "'converge:eps[:min_iters]'); per-request "
+                        "iterations-used p50/p95 land in the output "
+                        "record from the raft_iters_used histogram")
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--out", default="BENCH_serving.json")
     p.add_argument("--smoke", action="store_true",
@@ -171,6 +196,17 @@ def main() -> int:
         args.requests = min(args.requests, 24)
         args.clients = min(args.clients, 4)
         args.cpu = True
+        if args.iters_policy is None and not args.url:
+            # the smoke exercises the adaptive path by default: counted
+            # executables, policy-keyed cache, iters histogram — and the
+            # watchdog proves data-dependent trip counts never recompile.
+            # (--url: an external server's policy/watchdogs are its own —
+            # local flags can't configure it, so don't pretend to)
+            args.iters_policy = "converge:1e-2"
+        # recompile watchdog (PR 4): FlowServer installs the stack-wide
+        # XLA compile listener, armed after warmup — the smoke asserts
+        # its counter stays 0 with the policy on
+        os.environ["RAFT_TPU_WATCHDOGS"] = "1"
     if args.cpu:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -203,7 +239,8 @@ def main() -> int:
         sconfig = ServeConfig(
             buckets=parse_buckets(bucket_spec), max_batch=args.max_batch,
             max_wait_ms=args.max_wait_ms, queue_depth=args.queue_depth,
-            default_deadline_ms=args.deadline_ms, port=0)
+            default_deadline_ms=args.deadline_ms, port=0,
+            iters_policy=args.iters_policy)
         server = FlowServer(config, params, sconfig, verbose=False)
         t0 = time.monotonic()
         server.start()
@@ -261,6 +298,31 @@ def main() -> int:
         "shed_429": int(prom.get(
             'raft_serving_requests_total{status="shed"}', 0)),
     }
+    # adaptive-compute observables (round 8): per-request iterations spent,
+    # read back from the server's own raft_iters_used histogram.  The
+    # recorded policy is the SERVER's view: /healthz for an external
+    # --url target (local flags don't configure it), our flags in-process.
+    if args.url:
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.request("GET", "/healthz")
+            policy = json.loads(conn.getresponse().read()).get(
+                "iters_policy", "fixed")
+            conn.close()
+        except Exception:  # noqa: BLE001 — older server without the field
+            policy = None
+    else:
+        policy = args.iters_policy or "fixed"
+    iters_count = int(prom.get("raft_iters_used_count", 0))
+    if (policy and policy != "fixed") or iters_count:
+        rec["iters_policy"] = policy
+        rec["iters_used"] = {
+            "count": iters_count,
+            "mean": (round(prom.get("raft_iters_used_sum", 0.0)
+                           / iters_count, 3) if iters_count else None),
+            "p50": hist_percentile(prom, "raft_iters_used", 0.50),
+            "p95": hist_percentile(prom, "raft_iters_used", 0.95),
+        }
     # provenance (OBSERVABILITY.md): every BENCH_serving.json record carries
     # the run manifest — git sha, jax versions, device, config hash — so the
     # serving trajectory is attributable.  For --url (external server) the
@@ -285,6 +347,23 @@ def main() -> int:
         if rec["compile_misses_after_warmup"] != 0:
             problems.append(f"{rec['compile_misses_after_warmup']} "
                             f"compile(s) after warmup")
+        if args.iters_policy and args.iters_policy != "fixed" \
+                and not args.url:
+            # the adaptive-policy contract (in-process server only — an
+            # external server's watchdogs aren't ours to assert on):
+            # per-request counts observed, and the stack-wide watchdog saw
+            # ZERO XLA compiles after warmup — data-dependent trip counts
+            # never retrace
+            if not (rec.get("iters_used") or {}).get("count"):
+                problems.append("converge policy on but no iters_used "
+                                "observations")
+            recompiles = prom.get("raft_serving_xla_recompiles_total")
+            if recompiles is None:
+                problems.append("watchdog recompile counter missing from "
+                                "/metrics (RAFT_TPU_WATCHDOGS not live?)")
+            elif recompiles != 0:
+                problems.append(f"{int(recompiles)} XLA recompile(s) after "
+                                f"warmup with the converge policy on")
         if problems:
             print("[bench] SMOKE FAIL: " + "; ".join(problems))
             return 1

@@ -137,6 +137,13 @@ def summary_lines(path) -> List[str]:
                     f"ms/batch over {wait['count']} get(s) — the train-step "
                     f"starvation signal (raise --workers/--prefetch-depth "
                     f"if it rivals the step time)")
+            iu = rec["metrics"].get("raft_iters_used")
+            if isinstance(iu, dict) and iu.get("count"):
+                out.append(
+                    f"  adaptive iters: mean {iu['mean']:.2f} GRU "
+                    f"iteration(s) over {iu['count']} sample(s) — the "
+                    f"converge early-exit saving vs the declared max "
+                    f"(--iters-policy, OBSERVABILITY.md)")
         if rec.get("event") == "nonfinite":
             out.append(f"  NONFINITE at stage {rec.get('stage')!r} "
                        f"({rec.get('bad_values')} value(s))")
@@ -148,6 +155,14 @@ def summary_lines(path) -> List[str]:
         if "value" in rec and "metric" in rec:
             out.append(f"  {rec['metric']}: {rec['value']} "
                        f"{rec.get('unit', '')}".rstrip())
+            conv = rec.get("converge")
+            if isinstance(conv, dict):
+                for row in conv.get("rows", []):
+                    out.append(
+                        f"    {row['policy']}: "
+                        f"{row['pairs_per_sec']} pairs/s  "
+                        f"mean_iters {row['mean_iters']} "
+                        f"(fixed {conv.get('baseline_mean_iters')})")
     return out
 
 

@@ -65,6 +65,14 @@ def main() -> int:
     p.add_argument("--iters", type=int, default=None)
     p.add_argument("--small", action="store_true")
     p.add_argument("--load", default=None)
+    p.add_argument("--policy", default="converge:1e-2", metavar="POLICY",
+                   help="converge arm: rerun the cold/warm eval pair under "
+                        "this iters-policy and report iters-to-converge "
+                        "with vs without warm start (ROADMAP item 1 "
+                        "composition; 'none' skips the arm).  On random "
+                        "weights the canonical eps never fires — pass a "
+                        "calibrated eps (TUNING.md round 8) or --load a "
+                        "trained checkpoint for meaningful exits")
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args()
 
@@ -97,21 +105,41 @@ def main() -> int:
         ds = MpiSintel(root, "training", "clean")
         n = len(ds)
 
-        def timed(warm):
+        def timed(warm, cfg=None):
             t0 = time.perf_counter()
-            out = evaluate_dataset(params, config, ds, batch_size=1,
+            out = evaluate_dataset(params, cfg or config, ds, batch_size=1,
                                    warm_start=warm, verbose=False)
             dt = time.perf_counter() - t0
             assert out["samples"] == n
-            return dt
+            return dt, out
 
         # warm-up passes populate evaluate's lru-cached jitted executables
         # (training/evaluate._jitted_eval_fn), so the timed passes below are
         # compile-free
         timed(False)
         timed(True)
-        cold_s = timed(False)
-        warm_s = timed(True)
+        cold_s, _ = timed(False)
+        warm_s, _ = timed(True)
+
+        # converge arm: same frames, early-exit policy — does the warm
+        # start's better initialization convert into fewer GRU iterations?
+        converge = None
+        if args.policy and args.policy != "none":
+            import dataclasses
+            ccfg = dataclasses.replace(config, iters_policy=args.policy)
+            timed(False, ccfg)          # compile passes for both eval fns
+            timed(True, ccfg)
+            c_cold_s, c_cold = timed(False, ccfg)
+            c_warm_s, c_warm = timed(True, ccfg)
+            converge = {
+                "policy": args.policy,
+                "cold_pairs_per_s": round(n / c_cold_s, 3),
+                "warm_pairs_per_s": round(n / c_warm_s, 3),
+                "cold_mean_iters": round(c_cold.get("mean_iters",
+                                                    config.iters), 3),
+                "warm_mean_iters": round(c_warm.get("mean_iters",
+                                                    config.iters), 3),
+            }
 
     # isolated host-side projector cost at the 1/8 grid
     lr = (np.random.RandomState(1).randn(h // 8, w // 8, 2) * 2
@@ -135,6 +163,7 @@ def main() -> int:
         "warm_pairs_per_s": round(n / warm_s, 3),
         "warm_overhead_pct": round((warm_s - cold_s) / cold_s * 100, 1),
         "forward_interpolate_ms": round(fi_ms, 2),
+        "converge": converge,
         "manifest": run_manifest(config=config, mode="warmstart_bench"),
     }))
     return 0
