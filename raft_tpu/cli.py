@@ -287,6 +287,18 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="serve mode: skip the AOT warmup of the "
                         "(bucket x batch-step) compile grid (first "
                         "request per shape then pays its compile)")
+    p.add_argument("--max-sessions", type=int, default=64, metavar="N",
+                   help="serve mode: streaming (/v1/stream) session bound "
+                        "— at most N sessions keep device-resident "
+                        "feature maps; past it the LRU session's maps are "
+                        "evicted and its next frame cold-restarts "
+                        "(two encoder passes, correct flow).  0 disables "
+                        "streaming entirely")
+    p.add_argument("--session-ttl-s", type=float, default=300.0,
+                   metavar="T",
+                   help="serve mode: streaming sessions idle longer than "
+                        "T seconds are reaped; advancing a reaped id is a "
+                        "404 (the client reopens)")
     return p
 
 

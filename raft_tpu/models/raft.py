@@ -53,6 +53,50 @@ class RAFTOutput(NamedTuple):
     iters_used: Optional[jax.Array] = None
 
 
+def _validate_loop_config(config: RAFTConfig):
+    """Validate every update-loop knob up front (no-silent-fallback
+    contract: a typo'd policy/impl raises, never quietly runs the other
+    implementation) and reject unsupported sharding combinations BEFORE
+    any compute traces.  Shared by :func:`raft_forward` and
+    :func:`_iterate_flow` (the feature-reuse entries).  Returns the
+    parsed ``(policy, eps, min_iters)``."""
+    policy, eps, min_iters = parse_iters_policy(config.iters_policy)
+    if policy == "converge" and spmd.spatial_axis() is not None:
+        raise NotImplementedError(
+            "iters_policy='converge:...' under row-sharded (spatial) "
+            "execution is not wired: each shard would measure ‖Δflow‖ on "
+            "its local slab only and freeze samples at different "
+            "iterations; use iters_policy='fixed'.")
+    if config.gru_impl not in ("xla", "pallas"):
+        # same silent-fallback hazard as corr_lookup: a typo must not
+        # quietly run the other GRU implementation
+        raise ValueError(f"gru_impl must be 'xla' or 'pallas', "
+                         f"got {config.gru_impl!r}")
+    if config.gru_impl == "pallas" and config.small:
+        raise ValueError(
+            "gru_impl='pallas' covers the full model's SepConvGRU; the "
+            "small variant's 3x3 ConvGRU has no hand kernel — use "
+            "gru_impl='xla'.")
+    if config.gru_impl == "pallas" and spmd.spatial_axis() is not None:
+        raise NotImplementedError(
+            "gru_impl='pallas' under row-sharded (spatial) execution is not "
+            "wired: the kernel's row halo does not exchange across shards; "
+            "use gru_impl='xla' (conv2d halo-exchanges automatically).")
+    if config.corr_lookup not in ("gather", "onehot"):
+        # validated for every impl, not just dense — a typo must not fall
+        # back silently to the gather path
+        raise ValueError(f"corr_lookup must be 'gather' or 'onehot', "
+                         f"got {config.corr_lookup!r}")
+    if config.corr_precision not in ("highest", "default"):
+        # same silent-fallback hazard: a typo must not quietly degrade the
+        # corr matmuls to bf16 MXU inputs
+        raise ValueError(f"corr_precision must be 'highest' or 'default', "
+                         f"got {config.corr_precision!r}")
+    if config.scan_unroll < 1:
+        raise ValueError(f"scan_unroll must be >= 1, got {config.scan_unroll}")
+    return policy, eps, min_iters
+
+
 def init_raft(key: jax.Array, config: RAFTConfig) -> Dict[str, dict]:
     kf, kc, ku = jax.random.split(key, 3)
     corr_dim = config.corr_feature_dim
@@ -103,44 +147,13 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
     iters = config.iters if iters is None else iters
     all_flows = train if all_flows is None else all_flows
     cnet_norm = "none" if config.small else "batch"
-    # validated for every path up front — a typo'd policy must raise, not
-    # silently run fixed (the corr_lookup/gru_impl contract)
-    policy, eps, min_iters = parse_iters_policy(config.iters_policy)
-    adaptive = policy == "converge"
-    if adaptive and spmd.spatial_axis() is not None:
-        raise NotImplementedError(
-            "iters_policy='converge:...' under row-sharded (spatial) "
-            "execution is not wired: each shard would measure ‖Δflow‖ on "
-            "its local slab only and freeze samples at different "
-            "iterations; use iters_policy='fixed'.")
-    if config.gru_impl not in ("xla", "pallas"):
-        # same silent-fallback hazard as corr_lookup: a typo must not
-        # quietly run the other GRU implementation
-        raise ValueError(f"gru_impl must be 'xla' or 'pallas', "
-                         f"got {config.gru_impl!r}")
-    if config.gru_impl == "pallas" and config.small:
-        raise ValueError(
-            "gru_impl='pallas' covers the full model's SepConvGRU; the "
-            "small variant's 3x3 ConvGRU has no hand kernel — use "
-            "gru_impl='xla'.")
-    if config.gru_impl == "pallas" and spmd.spatial_axis() is not None:
-        raise NotImplementedError(
-            "gru_impl='pallas' under row-sharded (spatial) execution is not "
-            "wired: the kernel's row halo does not exchange across shards; "
-            "use gru_impl='xla' (conv2d halo-exchanges automatically).")
-    if config.small:
-        update_fn = apply_small_update_block
-    else:
-        update_fn = functools.partial(apply_basic_update_block,
-                                      gru_impl=config.gru_impl,
-                                      gru_block_rows=config.gru_block_rows)
-    cdt = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
+    # full config validation BEFORE the encoders: a typo'd policy/impl (or
+    # an unsupported sharding combination) must raise here, not after the
+    # fnet has already traced under a sharded context
+    policy_spec = _validate_loop_config(config)
 
     orig_params = params
-    if config.compute_dtype == "bfloat16":
-        # One cast at the top; correlation and upsampling stay float32.
-        params = jax.tree.map(lambda a: a.astype(jnp.bfloat16)
-                              if a.dtype == jnp.float32 else a, params)
+    params = _cast_params(params, config)
 
     B, H, W, _ = image1.shape
     if H % 8 or W % 8:
@@ -149,7 +162,6 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
             f"resize the inputs (see data.pipeline.pad_to_multiple).")
     if image2.shape != image1.shape:
         raise ValueError(f"image shapes differ: {image1.shape} vs {image2.shape}")
-    h, w = H // 8, W // 8
 
     x1 = _preprocess(image1, config)
     x2 = _preprocess(image2, config)
@@ -165,22 +177,66 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                                  dropout=config.dropout, rng=rngs[0])
     fmaps = nan_guard(fmaps, "raft/fnet")
     fmap1, fmap2 = fmaps[:B], fmaps[B:]
+
+    with stage("raft/cnet"):
+        cnet, new_cnet_params = apply_encoder(
+            params["cnet"], x1, cnet_norm, small=config.small, train=train,
+            axis_name=axis_name, dropout=config.dropout, rng=rngs[1],
+            bn_train=train and not freeze_bn)
+    net = jnp.tanh(cnet[..., :config.hidden_dim])
+    inp = jax.nn.relu(cnet[..., config.hidden_dim:])
+
+    out = _iterate_flow(params, fmap1, fmap2, net, inp, config,
+                        iters=iters, train=train, all_flows=all_flows,
+                        flow_init=flow_init, policy_spec=policy_spec)
+
+    new_params = dict(orig_params)
+    if train and not config.small and not freeze_bn:
+        # BN running stats updated in the cnet; restore original leaf dtypes.
+        # Under freeze_bn the ORIGINAL tree is returned untouched — the
+        # cast-down/cast-up round trip would otherwise bake bf16 rounding
+        # (~0.4% relative) into the frozen stats under
+        # compute_dtype='bfloat16', violating the left-untouched contract.
+        new_params["cnet"] = jax.tree.map(
+            lambda new, old: new.astype(old.dtype),
+            new_cnet_params, orig_params["cnet"])
+    return out, new_params
+
+
+def _iterate_flow(params, fmap1: jax.Array, fmap2: jax.Array,
+                  net: jax.Array, inp: jax.Array, config: RAFTConfig,
+                  iters: int, train: bool, all_flows: bool,
+                  flow_init: Optional[jax.Array],
+                  policy_spec=None) -> RAFTOutput:
+    """The recurrent core of RAFT, from encoder features to flow.
+
+    Shared by :func:`raft_forward` (which computes the features) and
+    :func:`forward_from_features` (which receives them precomputed — the
+    streaming serving path caches the previous frame's maps so each new
+    frame costs one encoder pass).  ``params`` must already carry the
+    compute-dtype cast; ``fmap1``/``fmap2`` are fnet outputs in any dtype
+    (correlation always casts to float32), ``net``/``inp`` the split
+    context activations at the 1/8 grid.  ``policy_spec`` is the parsed
+    ``(policy, eps, min_iters)`` from :func:`_validate_loop_config` —
+    public entries validate once, before their encoders, and pass it
+    down; None validates here (direct/test callers).
+    """
+    policy, eps, min_iters = (policy_spec if policy_spec is not None
+                              else _validate_loop_config(config))
+    adaptive = policy == "converge"
+    if config.small:
+        update_fn = apply_small_update_block
+    else:
+        update_fn = functools.partial(apply_basic_update_block,
+                                      gru_impl=config.gru_impl,
+                                      gru_block_rows=config.gru_block_rows)
+    cdt = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
+    B, h, w, _ = fmap1.shape
+
     # correlation always in float32 (numerics policy)
     fmap1c = fmap1.astype(jnp.float32)
     fmap2c = fmap2.astype(jnp.float32)
 
-    if config.corr_lookup not in ("gather", "onehot"):
-        # validated for every impl, not just dense — a typo must not fall
-        # back silently to the gather path
-        raise ValueError(f"corr_lookup must be 'gather' or 'onehot', "
-                         f"got {config.corr_lookup!r}")
-    if config.corr_precision not in ("highest", "default"):
-        # same silent-fallback hazard as corr_lookup: a typo must not
-        # quietly degrade the corr matmuls to bf16 MXU inputs
-        raise ValueError(f"corr_precision must be 'highest' or 'default', "
-                         f"got {config.corr_precision!r}")
-    if config.scan_unroll < 1:
-        raise ValueError(f"scan_unroll must be >= 1, got {config.scan_unroll}")
     corr_prec = (jax.lax.Precision.HIGHEST if config.corr_precision == "highest"
                  else jax.lax.Precision.DEFAULT)
 
@@ -239,14 +295,6 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                                    pack_rows=config.pallas_pack)
     else:
         raise ValueError(config.corr_impl)
-
-    with stage("raft/cnet"):
-        cnet, new_cnet_params = apply_encoder(
-            params["cnet"], x1, cnet_norm, small=config.small, train=train,
-            axis_name=axis_name, dropout=config.dropout, rng=rngs[1],
-            bn_train=train and not freeze_bn)
-    net = jnp.tanh(cnet[..., :config.hidden_dim])
-    inp = jax.nn.relu(cnet[..., config.hidden_dim:])
 
     coords0 = coords_grid(B, h, w)
     if spmd.spatial_axis() is not None:
@@ -385,18 +433,109 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
         with stage("raft/upsample"):
             flow = upsample(flow_lr, mask)
 
-    new_params = dict(orig_params)
-    if train and not config.small and not freeze_bn:
-        # BN running stats updated in the cnet; restore original leaf dtypes.
-        # Under freeze_bn the ORIGINAL tree is returned untouched — the
-        # cast-down/cast-up round trip would otherwise bake bf16 rounding
-        # (~0.4% relative) into the frozen stats under
-        # compute_dtype='bfloat16', violating the left-untouched contract.
-        new_params["cnet"] = jax.tree.map(
-            lambda new, old: new.astype(old.dtype),
-            new_cnet_params, orig_params["cnet"])
     return RAFTOutput(flow=flow, flow_iters=flow_iters, flow_lr=flow_lr,
-                      iters_used=iters_used), new_params
+                      iters_used=iters_used)
+
+
+def _cast_params(params: Dict[str, dict], config: RAFTConfig):
+    if config.compute_dtype != "bfloat16":
+        return params
+    # One cast at the top; correlation and upsampling stay float32.
+    return jax.tree.map(lambda a: a.astype(jnp.bfloat16)
+                        if a.dtype == jnp.float32 else a, params)
+
+
+@contract(image="*[B,H,W,3]")
+def encode_frame(params: Dict[str, dict], image: jax.Array,
+                 config: RAFTConfig) -> Tuple[jax.Array, jax.Array]:
+    """Encode ONE frame for sequential (video) inference: returns
+    ``(fmap, cnet)`` — the fnet feature map and the raw context-encoder
+    output, both at the 1/8 grid.
+
+    This is the cacheable per-frame state of the streaming serving path
+    (serving/session.py): ``fmap`` feeds correlation as frame 2 on this
+    step and as frame 1 on the next advance; ``cnet`` becomes the context
+    source when this frame is frame 1.  Inference-mode only (BN running
+    stats, no dropout) — exactly what :func:`raft_forward` computes for a
+    frame at ``train=False``, so flows built from cached maps match the
+    pairwise path.
+    """
+    H, W = image.shape[1], image.shape[2]
+    if H % 8 or W % 8:
+        raise ValueError(
+            f"RAFT requires H and W divisible by 8, got {(H, W)}; pad or "
+            f"resize the inputs (see data.pipeline.pad_to_multiple).")
+    params = _cast_params(params, config)
+    x = _preprocess(image, config)
+    with stage("raft/fnet"):
+        fmap, _ = apply_encoder(params["fnet"], x, "instance",
+                                small=config.small, train=False)
+    fmap = nan_guard(fmap, "raft/fnet")
+    cnet_norm = "none" if config.small else "batch"
+    with stage("raft/cnet"):
+        cnet, _ = apply_encoder(params["cnet"], x, cnet_norm,
+                                small=config.small, train=False)
+    return fmap, cnet
+
+
+@contract(fmap1="*[B,HL,WL,C]", fmap2="*[B,HL,WL,C]", cnet1="*[B,HL,WL,D]",
+          flow_init="*[B,HL,WL,2]")
+def forward_from_features(params: Dict[str, dict], fmap1: jax.Array,
+                          fmap2: jax.Array, cnet1: jax.Array,
+                          config: RAFTConfig, iters: Optional[int] = None,
+                          flow_init: Optional[jax.Array] = None
+                          ) -> RAFTOutput:
+    """Run the recurrent flow core from PRECOMPUTED encoder features.
+
+    ``fmap1``/``fmap2`` are :func:`encode_frame` fnet maps for the two
+    frames; ``cnet1`` is frame 1's raw context-encoder output.  With the
+    maps cached across a video session, flow(prev -> cur) costs one
+    encoder pass (the current frame's) instead of two, and ``flow_init``
+    (ops/warmstart.warm_start_seed of the previous low-res flow) lets a
+    ``converge:eps`` policy exit in a fraction of the cold iterations.
+    Inference-only: the equivalent of ``raft_forward(train=False,
+    all_flows=False)`` on the frames the features came from.
+    """
+    policy_spec = _validate_loop_config(config)
+    params = _cast_params(params, config)
+    net = jnp.tanh(cnet1[..., :config.hidden_dim])
+    inp = jax.nn.relu(cnet1[..., config.hidden_dim:])
+    return _iterate_flow(params, fmap1, fmap2, net, inp, config,
+                         iters=config.iters if iters is None else iters,
+                         train=False, all_flows=False, flow_init=flow_init,
+                         policy_spec=policy_spec)
+
+
+def make_encode_fn(config: RAFTConfig):
+    """A jittable (params, image) -> (fmap, cnet) single-frame encoder —
+    the session-open / cold-restart half of the streaming serving path."""
+    def fn(params, image):
+        return encode_frame(params, image, config)
+    return fn
+
+
+def make_stream_step_fn(config: RAFTConfig, iters: Optional[int] = None):
+    """A jittable streaming step: ``(params, image, fmap_prev, cnet_prev,
+    flow_init) -> (flow, flow_lr, fmap_cur, cnet_cur[, iters_used])``.
+
+    ONE device call advances a video session by one frame: encode the
+    current frame (one fnet + one cnet pass — the previous frame's maps
+    arrive cached), run the recurrent core with correlation
+    fmap_prev x fmap_cur and context from cnet_prev, and hand the current
+    frame's maps back for the session cache.  ``iters_used`` is appended
+    under an adaptive ``iters_policy`` (the serving engine's counted-
+    executable convention, engine.py)."""
+    from ..config import adaptive_iters
+    adaptive = adaptive_iters(config.iters_policy)
+
+    def fn(params, image, fmap_prev, cnet_prev, flow_init):
+        fmap_cur, cnet_cur = encode_frame(params, image, config)
+        out = forward_from_features(params, fmap_prev, fmap_cur, cnet_prev,
+                                    config, iters=iters, flow_init=flow_init)
+        if adaptive:
+            return out.flow, out.flow_lr, fmap_cur, cnet_cur, out.iters_used
+        return out.flow, out.flow_lr, fmap_cur, cnet_cur
+    return fn
 
 
 def make_inference_fn(config: RAFTConfig, iters: Optional[int] = None):

@@ -7,3 +7,4 @@ from .grid_sample import grid_sample, grid_sample_normalized
 from .norm import (batch_norm, group_norm, init_batch_norm, init_group_norm,
                    instance_norm)
 from .upsample import convex_upsample_flow
+from .warmstart import warm_start_seed

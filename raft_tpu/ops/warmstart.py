@@ -1,0 +1,48 @@
+"""Warm-start flow seeding for sequential (video) inference — host side.
+
+RAFT's video protocol initializes frame t+1's recurrence from frame t's
+low-resolution flow, forward-projected along itself (the official Sintel
+warm-start; utils.frame_utils.forward_interpolate).  The seed construction
+— zeros on a scene cut / missing / shape-mismatched previous flow, the
+projected previous flow otherwise — used to live inline in
+training/evaluate.py; it is shared here so the streaming serving path
+(serving/stream.py) and the evaluation harness build byte-identical seeds.
+
+This is deliberately host-side numpy: the projection is a scatter with
+conflict averaging plus a nearest-hit fill — cheap at the 1/8 grid
+(tools/warmstart_bench.py measures it) and data-dependent in a way XLA
+has no good native form for.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils.frame_utils import forward_interpolate
+
+
+def warm_start_seed(prev_flow_lr: Optional[np.ndarray],
+                    grid_hw: Tuple[int, int],
+                    reset: bool = False) -> np.ndarray:
+    """Build the ``flow_init`` seed for the next frame of a sequence.
+
+    ``prev_flow_lr``: the previous frame's 1/8-resolution flow,
+    ``[1, h, w, 2]`` (or ``[h, w, 2]``), or None when there is no usable
+    previous frame.  ``grid_hw``: the (h, w) of the NEXT frame's 1/8 grid.
+    ``reset``: force a cold start (scene boundary).
+
+    Returns ``[1, h, w, 2]`` float32: zeros for a cold start (identical to
+    no init), else the previous flow forward-projected along itself.  A
+    shape mismatch (resolution change mid-sequence) also resets cold —
+    the projection has no meaning across grids.
+    """
+    h, w = grid_hw
+    if (reset or prev_flow_lr is None
+            or prev_flow_lr.shape[-3:-1] != (h, w)):
+        return np.zeros((1, h, w, 2), np.float32)
+    prev = np.asarray(prev_flow_lr, np.float32)
+    if prev.ndim == 3:
+        prev = prev[None]
+    return forward_interpolate(prev[0])[None]

@@ -3,15 +3,20 @@
 The long-lived counterpart of the one-shot CLI modes: a bounded admission
 queue with deadlines and 429 load shedding, a dynamic micro-batcher that
 coalesces requests into pre-declared (resolution-bucket x batch-step)
-shapes, a warm AOT-compiled engine cache (no recompiles after warmup), and
-stdlib Prometheus-text observability over ``http.server``.
+shapes, a warm AOT-compiled engine cache (no recompiles after warmup),
+stdlib Prometheus-text observability over ``http.server``, and a
+sessionful streaming-video path (``/v1/stream``: cross-frame feature
+reuse + warm-started early exit, session.py/stream.py).
 """
 
 from .batcher import MicroBatcher
 from .config import ServeConfig, default_batch_steps, parse_buckets
 from .engine import InferenceEngine
 from .metrics import (Counter, Gauge, Histogram, Registry,
-                      make_serving_metrics)
+                      make_serving_metrics, make_stream_metrics)
 from .queue import (DeadlineExceeded, Draining, QueueFull, RejectedError,
                     Request, RequestQueue)
 from .server import FlowServer, serve_cli
+from .session import Session, SessionStore
+from .stream import (SessionBusy, StreamCoordinator, StreamRequest,
+                     UnknownSession)

@@ -11,6 +11,11 @@ original resolution, and resolves the waiting handler threads.
 The engine is injected as a callable ``run(bucket, im1, im2) -> flow`` so
 tests can drive the batching policy with a stub (slow / counting / failing)
 engine and never touch a compile.
+
+Streaming steps (serving/stream.py) share this thread — ONE owner of the
+device — but execute per session via the injected ``stream_fn``: the
+queue keys them per session id, so a popped run is either all-pairwise
+(coalesced) or a single session's step, never a mix.
 """
 
 from __future__ import annotations
@@ -28,9 +33,15 @@ from .queue import DeadlineExceeded, RequestQueue
 class MicroBatcher:
     def __init__(self, queue: RequestQueue, run_fn: Callable,
                  pad_batch_to: Callable[[int], int], max_batch: int,
-                 max_wait_ms: float, metrics: Optional[Dict] = None):
+                 max_wait_ms: float, metrics: Optional[Dict] = None,
+                 stream_fn: Optional[Callable] = None):
         self.queue = queue
         self.run_fn = run_fn
+        # streaming steps (serving/stream.py) ride the same queue and the
+        # same device-owning thread but execute per session: stream_fn
+        # takes ONE StreamRequest and returns (padded flow or None,
+        # iters_used or None)
+        self.stream_fn = stream_fn
         self.pad_batch_to = pad_batch_to
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1000.0
@@ -70,7 +81,53 @@ class MicroBatcher:
                 f"deadline exceeded after "
                 f"{time.monotonic() - r.enqueued_at:.3f}s in queue"))
 
+    def _execute_stream(self, r) -> None:
+        """One sessionful step (never coalesced: the queue keys stream
+        requests per session).  Batch-size/occupancy histograms are left
+        to pairwise batches — a stream step is definitionally batch 1 and
+        would only dilute the coalescing signal they exist to expose."""
+        if self.stream_fn is None:
+            r.fail(RuntimeError("stream request on a batcher without a "
+                                "stream executor"))
+            return
+        if r.abandoned:
+            # the handler gave up waiting (already counted status=timeout)
+            # and released the session lock: executing now would mutate
+            # session state a retry may be racing — drop the step instead
+            r.fail(DeadlineExceeded(
+                f"stream step {r.id} abandoned by its handler"))
+            return
+        self._observe("inflight", 1)
+        t0 = time.monotonic()
+        try:
+            flow, iters_used = self.stream_fn(r)
+        except BaseException as e:
+            self._observe("requests", "error", 1)
+            r.fail(e)
+            return
+        finally:
+            self._observe("inflight", -1)
+            self._observe("batch_latency", time.monotonic() - t0)
+        r.batch_real = r.batch_padded = 1
+        if iters_used is not None:
+            r.iters_used = int(np.asarray(iters_used).reshape(-1)[0])
+            self._observe("iters_used", float(r.iters_used))
+        now = time.monotonic()
+        self._observe("queue_latency", r.dequeued_at - r.enqueued_at)
+        self._observe("request_latency", now - r.enqueued_at)
+        self._observe("requests", "ok", 1)
+        self.served += 1
+        if flow is None:                 # session open: no pair yet
+            r.resolve(None)
+        else:
+            self._observe("pairs", 1.0)
+            r.resolve(unpad(flow[:1], r.pads)[0])
+
     def _execute(self, batch) -> None:
+        if getattr(batch[0], "stream_op", None) is not None:
+            for r in batch:
+                self._execute_stream(r)
+            return
         n = len(batch)
         padded = self.pad_batch_to(min(n, self.max_batch))
         im1 = np.concatenate([r.image1 for r in batch]

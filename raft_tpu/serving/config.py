@@ -93,6 +93,15 @@ class ServeConfig:
     # was compiled under, and each request's iterations-used lands in the
     # raft_iters_used histogram on /metrics.
     iters_policy: Optional[str] = None
+    # Streaming (/v1/stream, SERVING.md): at most this many video sessions
+    # hold device-resident feature maps; past it the LRU session's maps
+    # are evicted and its next advance degrades transparently to a cold
+    # two-encoder restart.  0 disables the endpoint (and its warmup
+    # executables) entirely.
+    max_sessions: int = 64
+    # Sessions idle longer than this are reaped outright (record included);
+    # advancing a reaped id is a 404 — the client reopens.
+    session_ttl_s: float = 300.0
 
     def __post_init__(self):
         if self.batch_steps is None:
@@ -112,6 +121,12 @@ class ServeConfig:
             raise ValueError(f"dp_devices must be >= 1, got {self.dp_devices}")
         if self.iters_policy is not None:
             parse_iters_policy(self.iters_policy)   # typo -> raise, up front
+        if self.max_sessions < 0:
+            raise ValueError(f"max_sessions must be >= 0 (0 disables "
+                             f"streaming), got {self.max_sessions}")
+        if not self.session_ttl_s > 0:
+            raise ValueError(f"session_ttl_s must be > 0, "
+                             f"got {self.session_ttl_s}")
         steps = tuple(sorted(set(self.batch_steps)))
         if not steps or steps[0] < 1:
             raise ValueError(f"batch_steps must be positive, got {steps}")

@@ -31,14 +31,19 @@ _log = get_logger("serve")
 
 
 class InferenceEngine:
-    """(bucket, batch, iters-policy) -> compiled executable, with hit/miss
-    accounting.  With ``iters_policy='converge:...'`` (ServeConfig override
-    or model-config default) every executable returns (flow, iters_used):
-    per-sample early exit runs INSIDE the compiled while_loop, so shapes —
-    and therefore the warm compile grid — never change with the data."""
+    """(kind, bucket, batch, iters-policy) -> compiled executable, with
+    hit/miss accounting.  ``kind`` is ``"pair"`` (the /v1/flow two-frame
+    executable), ``"encode"`` (single-frame fnet+cnet — session open /
+    cold restart), or ``"stream"`` (one-encoder sessionful step); the
+    streaming kinds share the cache, the warmup pass, and the no-recompile
+    discipline with the pairwise grid.  With
+    ``iters_policy='converge:...'`` (ServeConfig override or model-config
+    default) flow-producing executables return (…, iters_used): per-sample
+    early exit runs INSIDE the compiled while_loop, so shapes — and
+    therefore the warm compile grid — never change with the data."""
 
     def __init__(self, config: RAFTConfig, params, sconfig: ServeConfig,
-                 iters: Optional[int] = None):
+                 iters: Optional[int] = None, stream: bool = False):
         import jax
 
         if sconfig.iters_policy is not None:
@@ -70,28 +75,64 @@ class InferenceEngine:
             make = (make_counted_inference_fn if self.adaptive
                     else make_inference_fn)
             self._fn = jax.jit(make(config, iters=iters))
+        self.stream = stream
+        if stream:
+            # the streaming executables are plain single-device jits even
+            # under --serve-dp (batch-1 session steps cannot shard over
+            # the data axis); they live in the same cache and warm grid
+            from ..models.raft import make_encode_fn, make_stream_step_fn
+            self._encode_fn = jax.jit(make_encode_fn(config))
+            self._stream_fn = jax.jit(make_stream_step_fn(config,
+                                                          iters=iters))
+            self._feature_specs: Dict[Tuple[int, int, int], tuple] = {}
         self._lock = threading.Lock()
-        self._exec: Dict[Tuple[int, int, int, str], object] = {}
+        self._exec: Dict[Tuple[str, int, int, int, str], object] = {}
         self.compile_hits = 0
         self.compile_misses = 0
+        self.encode_calls = 0     # fnet-pass accounting: 1 per encode call,
+        self.stream_calls = 0     # 1 per stream step (the acceptance
+        self.pair_calls = 0       # criterion's counters), 2 per pair row
         self.warmup_seconds = 0.0
 
     # -- compile-cache bookkeeping ---------------------------------------
 
-    def _key(self, h: int, w: int, b: int) -> Tuple[int, int, int, str]:
-        """Engine-cache key: the iteration policy rides along with the
-        shape, so an executable can never be reused under a different
-        compute policy than it was warmed with (and stays warm across
-        every difficulty mix — early exit is inside the executable)."""
-        return (h, w, b, self.iters_policy)
+    def _key(self, h: int, w: int, b: int,
+             kind: str = "pair") -> Tuple[str, int, int, int, str]:
+        """Engine-cache key: the executable kind and the iteration policy
+        ride along with the shape, so an executable can never be reused
+        under a different compute policy than it was warmed with (and
+        stays warm across every difficulty mix — early exit is inside the
+        executable)."""
+        return (kind, h, w, b, self.iters_policy)
 
-    def _compile(self, key: Tuple[int, int, int, str]):
+    def _feature_shapes(self, h: int, w: int, b: int):
+        """Shape/dtype of the per-frame feature maps — derived from the
+        model itself (jax.eval_shape over the encode fn), never hardcoded,
+        so bf16 compute or a variant change flows through automatically."""
+        import jax
+        import jax.numpy as jnp
+        key = (h, w, b)
+        if key not in self._feature_specs:
+            img = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
+            self._feature_specs[key] = jax.eval_shape(
+                self._encode_fn, self.params, img)
+        return self._feature_specs[key]
+
+    def _compile(self, key: Tuple[str, int, int, int, str]):
         import jax
         import jax.numpy as jnp
 
-        h, w, b = key[:3]
-        spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
-        return self._fn.lower(self.params, spec, spec).compile()
+        kind, h, w, b = key[:4]
+        img = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
+        if kind == "pair":
+            return self._fn.lower(self.params, img, img).compile()
+        if kind == "encode":
+            return self._encode_fn.lower(self.params, img).compile()
+        assert kind == "stream", kind
+        fmap_s, cnet_s = self._feature_shapes(h, w, b)
+        flow_s = jax.ShapeDtypeStruct((b, h // 8, w // 8, 2), jnp.float32)
+        return self._stream_fn.lower(self.params, img, fmap_s, cnet_s,
+                                     flow_s).compile()
 
     def _get_executable(self, key: Tuple[int, int, int, str]):
         with self._lock:
@@ -115,19 +156,25 @@ class InferenceEngine:
         cache misses — `compile_misses` measures serve-time surprises."""
         t0 = time.monotonic()
         n = 0
-        for (h, w) in self.sconfig.buckets:
-            for b in self.sconfig.batch_steps:
-                key = self._key(h, w, b)
-                with self._lock:
-                    if key in self._exec:
-                        continue
-                ex = self._compile(key)
-                with self._lock:
-                    self._exec.setdefault(key, ex)
-                n += 1
-                if verbose:
-                    _log.info(f"warmed bucket {h}x{w} batch {b} "
-                              f"({time.monotonic() - t0:.1f}s elapsed)")
+        grid = [(h, w, b, "pair") for (h, w) in self.sconfig.buckets
+                for b in self.sconfig.batch_steps]
+        if self.stream:
+            # streaming executables run at batch 1 (one session step per
+            # device call); encode covers session open + cold restart
+            grid += [(h, w, 1, kind) for (h, w) in self.sconfig.buckets
+                     for kind in ("encode", "stream")]
+        for (h, w, b, kind) in grid:
+            key = self._key(h, w, b, kind)
+            with self._lock:
+                if key in self._exec:
+                    continue
+            ex = self._compile(key)
+            with self._lock:
+                self._exec.setdefault(key, ex)
+            n += 1
+            if verbose:
+                _log.info(f"warmed {kind} bucket {h}x{w} batch {b} "
+                          f"({time.monotonic() - t0:.1f}s elapsed)")
         self.warmup_seconds = time.monotonic() - t0
         return n
 
@@ -151,8 +198,39 @@ class InferenceEngine:
         h, w = bucket
         n = im1.shape[0]
         ex = self._get_executable(self._key(h, w, n))
+        self.pair_calls += 1
         out = ex(self.params, im1, im2)
         if self.adaptive:
             flow, iters_used = out
             return np.asarray(flow), np.asarray(iters_used)
         return np.asarray(out)
+
+    def run_encode(self, bucket: Tuple[int, int], image: np.ndarray):
+        """[1, BH, BW, 3] float32 frame -> DEVICE-resident (fmap, cnet)
+        maps — one fnet pass (session open / cold-restart half of the
+        streaming path).  The outputs are deliberately not pulled to
+        host: they are the session cache."""
+        h, w = bucket
+        ex = self._get_executable(self._key(h, w, image.shape[0], "encode"))
+        self.encode_calls += 1
+        return ex(self.params, image)
+
+    def run_stream(self, bucket: Tuple[int, int], image: np.ndarray,
+                   fmap_prev, cnet_prev, flow_init: np.ndarray):
+        """One sessionful step: current frame + cached previous maps +
+        warm-start seed -> (flow [1,BH,BW,2] np, flow_lr [1,bh,bw,2] np,
+        fmap_cur dev, cnet_cur dev, iters_used np or None).  Exactly one
+        fnet pass per call — the streaming saving the tests assert via
+        ``encode_calls``/``stream_calls``."""
+        h, w = bucket
+        ex = self._get_executable(self._key(h, w, image.shape[0], "stream"))
+        self.stream_calls += 1
+        out = ex(self.params, image, fmap_prev, cnet_prev, flow_init)
+        if self.adaptive:
+            flow, flow_lr, fmap, cnet, iters_used = out
+            iters_used = np.asarray(iters_used)
+        else:
+            flow, flow_lr, fmap, cnet = out
+            iters_used = None
+        return (np.asarray(flow), np.asarray(flow_lr), fmap, cnet,
+                iters_used)

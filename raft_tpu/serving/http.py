@@ -2,9 +2,10 @@
 
 Endpoints:
 
-  POST /v1/flow   infer optical flow for one image pair
-  GET  /healthz   liveness/readiness (503 while draining)
-  GET  /metrics   Prometheus text exposition
+  POST /v1/flow    infer optical flow for one image pair
+  POST /v1/stream  sessionful video flow: open / advance / close
+  GET  /healthz    liveness/readiness (503 while draining)
+  GET  /metrics    Prometheus text exposition
 
 ``/v1/flow`` accepts two encodings:
 
@@ -18,10 +19,20 @@ Endpoints:
   is an ``.npz`` holding ``flow`` — the cheap path for real clients and
   the load bench.
 
-Error statuses: 400 malformed/unroutable input, 404 unknown path, 413 body
-too large, 429 queue full (shed — retry with backoff), 503 draining,
-504 deadline exceeded.  Every terminal status increments
-``raft_serving_requests_total{status=...}``.
+``/v1/stream`` (SERVING.md streaming section) speaks the same two
+encodings.  One field set drives three ops: ``op`` = ``open`` (first
+frame of a session; default when no ``session`` is given), ``advance``
+(next frame — returns flow(prev -> cur); default with a ``session``), or
+``close``.  ``open``/``advance`` require ``image`` ([H, W, 3], same value
+conventions as /v1/flow); ``advance``/``close`` require ``session`` (the
+hex id ``open`` returned).  npz bodies carry ``op``/``session`` as 0-d
+string arrays.
+
+Error statuses: 400 malformed/unroutable input, 404 unknown path or
+unknown/expired stream session, 409 stream session busy (a frame already
+in flight), 413 body too large, 429 queue full (shed — retry with
+backoff), 503 draining, 504 deadline exceeded.  Every terminal status
+increments ``raft_serving_requests_total{status=...}``.
 """
 
 from __future__ import annotations
@@ -101,6 +112,60 @@ def parse_flow_request(body: bytes, content_type: str):
     return im1, im2, dl
 
 
+def parse_stream_request(body: bytes, content_type: str):
+    """-> (op, session_id or None, image or None, deadline_ms or None).
+    Raises BadRequest.  ``op`` defaults from the fields present: no
+    session -> ``open``, session given -> ``advance``."""
+    ct = (content_type or "").split(";")[0].strip().lower()
+    if ct == "application/octet-stream":
+        try:
+            with np.load(io.BytesIO(body)) as z:
+                op = str(z["op"]) if "op" in z else None
+                sid = str(z["session"]) if "session" in z else None
+                image = (_decode_image(z["image"], "image")
+                         if "image" in z else None)
+                dl = float(z["deadline_ms"]) if "deadline_ms" in z else None
+        except BadRequest:
+            raise
+        except Exception as e:
+            raise BadRequest(f"could not read npz body: {e}")
+    else:
+        try:
+            payload = json.loads(body)
+        except Exception as e:
+            raise BadRequest(f"invalid JSON body: {e}")
+        if not isinstance(payload, dict):
+            raise BadRequest("JSON body must be an object")
+        op = payload.get("op")
+        sid = payload.get("session")
+        if sid is not None and not isinstance(sid, str):
+            raise BadRequest("session must be a string id")
+        image = None
+        if "image" in payload:
+            try:
+                image = _decode_image(payload["image"], "image")
+            except BadRequest:
+                raise
+            except Exception as e:
+                raise BadRequest(f"could not decode image: {e}")
+        dl = payload.get("deadline_ms")
+        if dl is not None:
+            try:
+                dl = float(dl)
+            except (TypeError, ValueError):
+                raise BadRequest("deadline_ms must be a number")
+    if op is None:
+        op = "advance" if sid else "open"
+    if op not in ("open", "advance", "close"):
+        raise BadRequest(f"op must be 'open', 'advance' or 'close', "
+                         f"got {op!r}")
+    if op in ("open", "advance") and image is None:
+        raise BadRequest(f"op {op!r} requires an image")
+    if op in ("advance", "close") and not sid:
+        raise BadRequest(f"op {op!r} requires a session id")
+    return op, sid, image, dl
+
+
 class _Handler(BaseHTTPRequestHandler):
     # the FlowServer instance; set on the subclass by make_http_server
     server_app = None
@@ -133,7 +198,7 @@ class _Handler(BaseHTTPRequestHandler):
             if app.draining:
                 self._send_json(503, {"status": "draining"})
             else:
-                self._send_json(200, {
+                health = {
                     "status": "ok",
                     "buckets": [list(b) for b in app.sconfig.buckets],
                     "batch_steps": list(app.sconfig.batch_steps),
@@ -141,28 +206,45 @@ class _Handler(BaseHTTPRequestHandler):
                                             "fixed"),
                     "queue_depth": len(app.queue),
                     "executables": app.engine_executables(),
-                })
+                }
+                streams = getattr(app, "streams", None)
+                if streams is not None:
+                    health["stream"] = {
+                        "max_sessions": app.sconfig.max_sessions,
+                        "session_ttl_s": app.sconfig.session_ttl_s,
+                        "sessions_active": streams.store.active_count(),
+                        "sessions_resident": streams.store.resident_count(),
+                    }
+                self._send_json(200, health)
         elif path == "/metrics":
             self._send(200, app.registry.render().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
         else:
             self._send_json(404, {"error": f"no handler for {path}"})
 
-    def do_POST(self):
-        app = self.server_app
-        path = self.path.split("?")[0]
-        if path != "/v1/flow":
-            self._send_json(404, {"error": f"no handler for {path}"})
-            return
+    def _read_body(self):
         try:
             length = int(self.headers.get("Content-Length", 0))
         except ValueError:
             length = -1
         if length < 0 or length > MAX_BODY_BYTES:
-            app.count_request("bad_request")
+            self.server_app.count_request("bad_request")
             self._send_json(413, {"error": "bad or oversized Content-Length"})
+            return None
+        return self.rfile.read(length)
+
+    def do_POST(self):
+        app = self.server_app
+        path = self.path.split("?")[0]
+        if path == "/v1/stream":
+            self._post_stream()
             return
-        body = self.rfile.read(length)
+        if path != "/v1/flow":
+            self._send_json(404, {"error": f"no handler for {path}"})
+            return
+        body = self._read_body()
+        if body is None:
+            return
         try:
             im1, im2, deadline_ms = parse_flow_request(
                 body, self.headers.get("Content-Type", "application/json"))
@@ -204,6 +286,52 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, buf.getvalue(), "application/octet-stream")
         else:
             self._send_json(200, {"flow": req.result.tolist(), "meta": meta})
+
+    def _post_stream(self):
+        app = self.server_app
+        body = self._read_body()
+        if body is None:
+            return
+        try:
+            op, sid, image, deadline_ms = parse_stream_request(
+                body, self.headers.get("Content-Type", "application/json"))
+        except BadRequest as e:
+            app.count_request("bad_request")
+            self._send_json(400, {"error": str(e)})
+            return
+        try:
+            res = app.stream_call(op, sid, image, deadline_ms)
+        except RejectedError as e:
+            # includes UnknownSession (404) and SessionBusy (409) — the
+            # status rides on the exception like every rejection
+            self._send_json(e.http_status, {"error": str(e)})
+            return
+        except BadRequest as e:
+            app.count_request("bad_request")
+            self._send_json(400, {"error": str(e)})
+            return
+        except Exception as e:
+            self._send_json(500, {"error": f"inference failed: {e}"})
+            return
+        flow = res.pop("flow", None)
+        if "application/octet-stream" in (self.headers.get("Accept") or ""):
+            buf = io.BytesIO()
+            arrays = {"session": np.asarray(res["session"]),
+                      "frame": np.asarray(res.get("frame", 0), np.int32)}
+            if flow is not None:
+                arrays["flow"] = flow
+            meta = res.get("meta") or {}
+            if "warm" in meta:
+                arrays["warm"] = np.asarray(meta["warm"])
+            if "iters_used" in meta:
+                arrays["iters_used"] = np.asarray(meta["iters_used"],
+                                                  np.int32)
+            np.savez(buf, **arrays)
+            self._send(200, buf.getvalue(), "application/octet-stream")
+        else:
+            if flow is not None:
+                res["flow"] = flow.tolist()
+            self._send_json(200, res)
 
 
 def make_http_server(app, host: str, port: int) -> ThreadingHTTPServer:

@@ -82,3 +82,43 @@ def make_serving_metrics(registry: Registry, config,
             "Mean GRU iterations per request (adaptive-compute saving)",
             fn=iters_used.mean),
     }
+
+
+def make_stream_metrics(registry: Registry, store) -> Dict[str, _Metric]:
+    """The streaming (/v1/stream) metric families — one definition site,
+    same contract as :func:`make_serving_metrics`.  The session gauges are
+    live callbacks on the store; the eviction counter is handed back to
+    the store so it can label the reason at the decision site."""
+    m = {
+        "sessions_active": registry.gauge(
+            "raft_stream_sessions_active",
+            "Sessions holding device-resident feature maps "
+            "(bounded by --max-sessions)",
+            fn=store.active_count),
+        "sessions_resident": registry.gauge(
+            "raft_stream_sessions_resident",
+            "Session records resident, demoted (features evicted) included",
+            fn=store.resident_count),
+        "opens": registry.counter(
+            "raft_stream_opens_total",
+            "Sessions opened"),
+        "frames": registry.counter(
+            "raft_stream_frames_total",
+            "Stream advances served (one flow pair each)"),
+        "fnet_hits": registry.counter(
+            "raft_stream_fnet_cache_hits_total",
+            "Advances served from cached previous-frame features "
+            "(ONE encoder pass instead of two)"),
+        "fnet_misses": registry.counter(
+            "raft_stream_fnet_cache_misses_total",
+            "Advances that cold-restarted (features evicted: two encoder "
+            "passes, pairwise cost, correct flow)"),
+        "evictions": registry.counter(
+            "raft_stream_evictions_total",
+            "Session evictions by reason: lru (features demoted past "
+            "--max-sessions), ttl (idle record reaped), capacity "
+            "(record evicted outright)",
+            labelnames=("reason",)),
+    }
+    store.evictions = m["evictions"]
+    return m

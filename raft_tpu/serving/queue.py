@@ -130,12 +130,18 @@ class RequestQueue:
 
     def _purge_expired_locked(self, now: float) -> List[Request]:
         expired = []
-        for bucket, fifo in self._by_bucket.items():
+        for bucket, fifo in list(self._by_bucket.items()):
             keep = []
             for r in fifo:
                 (expired if r.deadline <= now else keep).append(r)
             if len(keep) != len(fifo):
-                self._by_bucket[bucket] = keep
+                # drop emptied keys: stream requests key per SESSION, so a
+                # long-lived server would otherwise accrete one dead list
+                # per session ever seen
+                if keep:
+                    self._by_bucket[bucket] = keep
+                else:
+                    del self._by_bucket[bucket]
         self._size -= len(expired)
         return expired
 
@@ -167,7 +173,11 @@ class RequestQueue:
                     aged = now - best_head >= max_wait
                     if full or aged or self._closed:
                         batch = fifo[:max_batch]
-                        self._by_bucket[best] = fifo[len(batch):]
+                        rest = fifo[len(batch):]
+                        if rest:
+                            self._by_bucket[best] = rest
+                        else:           # see _purge_expired_locked
+                            del self._by_bucket[best]
                         self._size -= len(batch)
                         for r in batch:
                             r.dequeued_at = now

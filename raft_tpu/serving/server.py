@@ -34,8 +34,10 @@ from .batcher import MicroBatcher
 from .config import ServeConfig
 from .engine import InferenceEngine
 from .http import BadRequest, make_http_server, serve_in_thread
-from .metrics import Registry, make_serving_metrics
+from .metrics import Registry, make_serving_metrics, make_stream_metrics
 from .queue import DeadlineExceeded, Draining, Request, RequestQueue
+from .session import SessionStore
+from .stream import StreamCoordinator
 
 _log = get_logger("serve")
 
@@ -60,12 +62,24 @@ class FlowServer:
         self.registry.gauge("raft_serving_queue_limit",
                             "Admission queue capacity (backpressure bound)"
                             ).set(sconfig.queue_depth)
+        # streaming (/v1/stream): a bounded session store + coordinator,
+        # built only when declared (--max-sessions > 0) so a pairwise-only
+        # server keeps its exact warmup grid and /metrics exposition
+        self.streams = None
+        if sconfig.max_sessions > 0:
+            store = SessionStore(sconfig.max_sessions, sconfig.session_ttl_s)
+            self.streams = StreamCoordinator(
+                store, sconfig, self.queue,
+                make_stream_metrics(self.registry, store),
+                self.count_request)
         # engine injection: tests drive the batching policy with stubs
         self.engine = engine if engine is not None else InferenceEngine(
-            config, params, sconfig, iters=iters)
+            config, params, sconfig, iters=iters,
+            stream=sconfig.max_sessions > 0)
         self.batcher = MicroBatcher(
             self.queue, self._run_engine, sconfig.pad_batch_to,
-            sconfig.max_batch, sconfig.max_wait_ms, metrics=self.metrics)
+            sconfig.max_batch, sconfig.max_wait_ms, metrics=self.metrics,
+            stream_fn=self._run_stream if self.streams else None)
         self._httpd = None
         self._http_thread = None
         self._draining = threading.Event()
@@ -81,6 +95,22 @@ class FlowServer:
         before = getattr(self.engine, "compile_misses", None)
         with stage("serve/batch"):
             out = self.engine.run(bucket, im1, im2)
+        if before is not None:
+            after = self.engine.compile_misses
+            if after > before:
+                self.metrics["compile_misses"].inc(after - before)
+            else:
+                self.metrics["compile_hits"].inc()
+        return out
+
+    def _run_stream(self, req):
+        """Stream-step twin of _run_engine: same trace window, same
+        compile-cache accounting, one session step per call."""
+        self._trace_window.on_step(self._device_batches)
+        self._device_batches += 1
+        before = getattr(self.engine, "compile_misses", None)
+        with stage("serve/stream"):
+            out = self.streams.execute(req, self.engine)
         if before is not None:
             after = self.engine.compile_misses
             if after > before:
@@ -207,6 +237,21 @@ class FlowServer:
             raise
         return req
 
+    def stream_call(self, op: str, session_id, image, deadline_ms):
+        """/v1/stream bridge: dispatch one open/advance/close to the
+        stream coordinator (http handler threads)."""
+        if self.streams is None:
+            raise BadRequest("streaming is disabled on this server "
+                             "(--max-sessions 0); use /v1/flow")
+        if self.draining:
+            self.count_request("draining")
+            raise Draining("server is draining; not accepting requests")
+        if op == "open":
+            return self.streams.open(image, deadline_ms)
+        if op == "close":
+            return self.streams.close(session_id)
+        return self.streams.advance(session_id, image, deadline_ms)
+
 
 def serve_cli(args, config: RAFTConfig, load_params) -> int:
     """-m serve: build, warm, serve until SIGINT/SIGTERM, drain, exit 0."""
@@ -224,7 +269,12 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
             host=args.host, port=args.port,
             dp_devices=args.serve_dp or 1,
             warmup=not args.no_warmup,
-            iters_policy=getattr(args, "iters_policy", None))
+            iters_policy=getattr(args, "iters_policy", None),
+            # argparse owns the defaults; `or`-style fallbacks would
+            # silently turn an (invalid) explicit 0 into the default
+            # instead of letting ServeConfig raise on it
+            max_sessions=getattr(args, "max_sessions", 64),
+            session_ttl_s=getattr(args, "session_ttl_s", 300.0))
     except ValueError as e:
         print(f"ERROR: {e}")
         return 2
@@ -243,6 +293,10 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
           f"queue_depth={sconfig.queue_depth}  "
           f"iters_policy={server.engine.iters_policy}  "
           f"({time.monotonic() - t0:.1f}s to ready)")
+    if server.streams is not None:
+        print(f"[serve] streaming: max_sessions={sconfig.max_sessions}  "
+              f"session_ttl={sconfig.session_ttl_s:.0f}s  "
+              f"POST {server.url}/v1/stream")
     print(f"[serve] POST {server.url}/v1/flow   "
           f"GET {server.url}/healthz   GET {server.url}/metrics")
 

@@ -1,0 +1,176 @@
+"""Bounded per-session state for the streaming video path (SERVING.md).
+
+A *session* is one client's video stream.  Its state has two tiers:
+
+* **device tier** — the previous frame's encoder maps (``fmap`` + raw
+  ``cnet`` output, each ``[1, H/8, W/8, C]`` device-resident) and the
+  previous low-res flow (host, the warm-start seed).  This is what makes
+  the next advance cost ONE encoder pass and exit early under a
+  ``converge`` policy — and it is the expensive, scarce resource.
+* **host tier** — the previous frame's pixels plus bookkeeping.  Cheap,
+  and exactly what a cold two-encoder restart needs.
+
+``SessionStore`` bounds both.  At most ``max_sessions`` sessions hold
+device features; promoting one past the cap *demotes* the least-recently-
+used holder (device tier dropped, host tier kept), so an advance on a
+demoted session degrades transparently to a cold two-encoder restart —
+correct flow, no error, just the pairwise cost.  Session records
+themselves are capped at ``RECORD_CAP_FACTOR x max_sessions`` (oldest
+records evicted outright) and reaped entirely after ``ttl_s`` idle
+seconds; an advance on a reaped/unknown id is a 404 — the client reopens.
+
+Thread model: handler threads open/advance/close under the store lock and
+hold the per-session lock across a whole advance (one frame in flight per
+session); feature attach/demote runs in the batcher thread.  A session
+may be demoted *between* enqueue and execute — the coordinator re-checks
+``has_features`` at execute time and falls back cold, which is the
+designed behavior, not a race.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+# Demoted records (host tier only) are kept for graceful cold restarts up
+# to this multiple of max_sessions; beyond it the oldest records are
+# evicted outright (reason="capacity") and their ids become unknown.
+RECORD_CAP_FACTOR = 4
+
+
+class Session:
+    """One client stream's cached state.  Mutated only while its ``lock``
+    is held (handler thread) or from the batcher thread during execute."""
+
+    __slots__ = ("id", "bucket", "lock", "created_at", "last_used",
+                 "frames", "last_image", "fmap", "cnet", "prev_flow_lr")
+
+    def __init__(self, sid: str, bucket: Tuple[int, int]):
+        self.id = sid
+        self.bucket = bucket
+        self.lock = threading.Lock()
+        self.created_at = self.last_used = time.monotonic()
+        self.frames = 0                  # advances served (pairs)
+        self.last_image = None           # [1, BH, BW, 3] float32, host
+        self.fmap = None                 # [1, BH/8, BW/8, C] device
+        self.cnet = None                 # [1, BH/8, BW/8, D] device
+        self.prev_flow_lr = None         # [1, BH/8, BW/8, 2] float32, host
+
+    @property
+    def has_features(self) -> bool:
+        return self.fmap is not None
+
+    def drop_features(self) -> None:
+        self.fmap = self.cnet = self.prev_flow_lr = None
+
+
+class SessionStore:
+    """LRU + TTL bounded session registry (one per FlowServer)."""
+
+    def __init__(self, max_sessions: int, ttl_s: float):
+        if max_sessions < 1:
+            raise ValueError(f"max_sessions must be >= 1 to build a store, "
+                             f"got {max_sessions}")
+        if not ttl_s > 0:
+            raise ValueError(f"session_ttl_s must be > 0, got {ttl_s}")
+        self.max_sessions = max_sessions
+        self.record_cap = RECORD_CAP_FACTOR * max_sessions
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._sessions: "OrderedDict[str, Session]" = OrderedDict()
+        # set by make_stream_metrics: a labeled counter with reason=
+        # lru (features demoted), ttl (record reaped), capacity (record
+        # evicted outright).  None until wired — the store works bare.
+        self.evictions = None
+
+    # -- accounting (live gauge callbacks, sampled at scrape time) ---------
+
+    def active_count(self) -> int:
+        """Sessions holding device features (the --max-sessions bound)."""
+        with self._lock:
+            return sum(1 for s in self._sessions.values() if s.has_features)
+
+    def resident_count(self) -> int:
+        """Session records resident, demoted included."""
+        with self._lock:
+            return len(self._sessions)
+
+    def _evict(self, reason: str) -> None:
+        if self.evictions is not None:
+            self.evictions.labels(reason).inc()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def open(self, bucket: Tuple[int, int]) -> Session:
+        """Create a fresh session record (features attach on first
+        encode).  Enforces the record cap by evicting the oldest
+        not-in-flight records outright."""
+        s = Session(uuid.uuid4().hex, bucket)
+        with self._lock:
+            while len(self._sessions) >= self.record_cap:
+                victim = self._pop_lru_locked()
+                if victim is None:       # everything in flight: admit anyway
+                    break
+                self._evict("capacity")
+            self._sessions[s.id] = s
+        return s
+
+    def get(self, sid: str) -> Optional[Session]:
+        """Look up + touch (LRU order and TTL clock)."""
+        with self._lock:
+            s = self._sessions.get(sid)
+            if s is not None:
+                s.last_used = time.monotonic()
+                self._sessions.move_to_end(sid)
+            return s
+
+    def close(self, sid: str) -> Optional[Session]:
+        with self._lock:
+            return self._sessions.pop(sid, None)
+
+    def sweep(self, now: Optional[float] = None) -> int:
+        """Reap records idle past the TTL (skipping in-flight sessions);
+        called opportunistically from the request path — no sweeper
+        thread to leak."""
+        now = time.monotonic() if now is None else now
+        reaped = 0
+        with self._lock:
+            for sid in [sid for sid, s in self._sessions.items()
+                        if now - s.last_used > self.ttl_s
+                        and not s.lock.locked()]:
+                self._sessions.pop(sid)
+                self._evict("ttl")
+                reaped += 1
+        return reaped
+
+    # -- the device-feature bound -----------------------------------------
+
+    def attach_features(self, session: Session, fmap, cnet,
+                        prev_flow_lr) -> None:
+        """Install a session's fresh device maps (batcher thread), then
+        demote LRU feature-holders until at most ``max_sessions`` remain —
+        the device-memory bound the store exists for."""
+        session.fmap, session.cnet = fmap, cnet
+        session.prev_flow_lr = prev_flow_lr
+        with self._lock:
+            session.last_used = time.monotonic()
+            holders = [s for s in self._sessions.values()
+                       if s.has_features and s is not session]
+            excess = len(holders) + 1 - self.max_sessions
+            for s in holders:            # OrderedDict order = LRU first
+                if excess <= 0:
+                    break
+                if s.lock.locked():      # mid-advance: not a demotion target
+                    continue
+                s.drop_features()
+                self._evict("lru")
+                excess -= 1
+
+    def _pop_lru_locked(self) -> Optional[Session]:
+        for sid, s in self._sessions.items():
+            if not s.lock.locked():
+                return self._sessions.pop(sid)
+        return None

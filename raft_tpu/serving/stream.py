@@ -1,0 +1,214 @@
+"""Sessionful streaming flow: the machinery behind ``POST /v1/stream``.
+
+Protocol (wire format parsed in http.py): a client *opens* a session with
+its first frame, *advances* it one frame at a time — each advance returns
+flow(prev -> cur) — and *closes* it.  Per advance the server runs ONE
+encoder pass (the current frame's; the previous frame's fmap/context maps
+are cached device-side in the session) and warm-starts the recurrence
+from the previous flow forward-projected along itself
+(ops/warmstart.warm_start_seed — RAFT's own Sintel video protocol), so a
+``converge:eps`` iteration policy exits in a fraction of the cold count.
+
+Stream steps ride the SAME admission queue and batcher thread as
+``/v1/flow`` (bounded depth -> 429, deadlines -> 504, graceful drain),
+keyed per session so they never coalesce with pairwise batches; the
+session lock serializes frames within a session (a concurrent advance on
+the same session answers 409 rather than reordering the recurrence).
+
+Evicted (demoted) sessions degrade transparently: the advance re-encodes
+the retained previous frame — the cold two-encoder cost, the same flow.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..data.pipeline import pad_to_shape
+from ..ops.warmstart import warm_start_seed
+from .queue import (DeadlineExceeded, Draining, RejectedError, Request,
+                    RequestQueue)
+from .session import Session, SessionStore
+
+
+class UnknownSession(RejectedError):
+    """Session id never existed, was closed, or aged out (TTL) — reopen."""
+    http_status = 404
+
+
+class SessionBusy(RejectedError):
+    """A frame for this session is already in flight (advances are
+    strictly sequential: frame t's flow seeds frame t+1)."""
+    http_status = 409
+
+
+class StreamRequest(Request):
+    """One stream step in flight.  ``bucket`` is the queue key — per
+    session, so the batcher pops stream steps alone, never coalesced with
+    pairwise work or other sessions."""
+
+    __slots__ = ("session", "stream_op", "warm", "frame", "abandoned")
+
+    def __init__(self, session: Session, op: str, image_padded, pads,
+                 deadline: float):
+        super().__init__(image_padded, None, ("stream", session.id), pads,
+                         deadline)
+        self.session = session
+        self.stream_op = op              # "open" | "advance"
+        self.warm = False                # set at execute time
+        self.frame = 0
+        # set by the handler when wait() gives up (batcher stalled past
+        # the deadline margin): the batcher must then SKIP the step
+        # instead of mutating session state after the session lock was
+        # released — a late orphaned step would otherwise consume the
+        # frame a client retry is about to resubmit
+        self.abandoned = False
+
+
+class StreamCoordinator:
+    """Owns the session store and the stream-step device recipe.
+
+    Handler threads call :meth:`open`/:meth:`advance`/:meth:`close`
+    (validate, lock the session, enqueue, block); the batcher thread calls
+    :meth:`execute` (the only place device state moves).
+    """
+
+    def __init__(self, store: SessionStore, sconfig, queue: RequestQueue,
+                 metrics: Dict, count_fn):
+        self.store = store
+        self.sconfig = sconfig
+        self.queue = queue
+        self.metrics = metrics           # make_stream_metrics families
+        self.count = count_fn            # FlowServer.count_request
+
+    # -- handler-thread API ------------------------------------------------
+
+    def open(self, image: np.ndarray,
+             deadline_ms: Optional[float]) -> Dict:
+        from .http import BadRequest    # circular-free: http imports us not
+        self.store.sweep()
+        h, w = image.shape[0], image.shape[1]
+        bucket = self.sconfig.route(h, w)
+        if bucket is None:
+            raise BadRequest(
+                f"no declared bucket fits ({h}, {w}); buckets: "
+                f"{[f'{bh}x{bw}' for bh, bw in self.sconfig.buckets]}")
+        s = self.store.open(bucket)
+        with s.lock:
+            try:
+                self._run_step(s, "open", image, deadline_ms)
+            except BaseException:
+                self.store.close(s.id)   # no half-open sessions
+                raise
+        self.metrics["opens"].inc()
+        return {"session": s.id, "frame": 0,
+                "meta": {"bucket": list(bucket)}}
+
+    def advance(self, sid: Optional[str], image: np.ndarray,
+                deadline_ms: Optional[float]) -> Dict:
+        from .http import BadRequest
+        self.store.sweep()
+        s = self.store.get(sid) if sid else None
+        if s is None:
+            self.count("unknown_session")
+            raise UnknownSession(
+                f"unknown session {sid!r} (closed, expired after "
+                f"{self.sconfig.session_ttl_s:.0f}s idle, or never "
+                f"opened) — open a new one")
+        if not s.lock.acquire(blocking=False):
+            self.count("session_busy")
+            raise SessionBusy(f"session {sid} already has a frame in "
+                              f"flight; advances are sequential")
+        try:
+            h, w = image.shape[0], image.shape[1]
+            if self.sconfig.route(h, w) != s.bucket:
+                raise BadRequest(
+                    f"frame ({h}, {w}) does not route to this session's "
+                    f"bucket {s.bucket}; resolution changes mid-stream "
+                    f"need a new session")
+            req = self._run_step(s, "advance", image, deadline_ms)
+        finally:
+            s.lock.release()
+        meta = {"bucket": list(s.bucket), "warm": req.warm,
+                "batch_real": req.batch_real,
+                "batch_padded": req.batch_padded}
+        if req.iters_used is not None:
+            meta["iters_used"] = req.iters_used
+        return {"session": s.id, "frame": req.frame, "flow": req.result,
+                "meta": meta}
+
+    def close(self, sid: Optional[str]) -> Dict:
+        s = self.store.close(sid) if sid else None
+        if s is None:
+            self.count("unknown_session")
+            raise UnknownSession(f"unknown session {sid!r}")
+        return {"session": sid, "closed": True, "frames": s.frames}
+
+    def _run_step(self, s: Session, op: str, image: np.ndarray,
+                  deadline_ms: Optional[float]) -> StreamRequest:
+        """Pad, enqueue, block until the batcher resolves — the stream
+        twin of FlowServer.infer, same deadline/shed/drain accounting."""
+        from .http import BadRequest
+        dl = (self.sconfig.default_deadline_ms if deadline_ms is None
+              else min(deadline_ms, self.sconfig.default_deadline_ms))
+        if dl <= 0:
+            raise BadRequest(f"deadline_ms must be positive, got {dl}")
+        imp, pads = pad_to_shape(image[None].astype(np.float32), s.bucket)
+        req = StreamRequest(s, op, imp, pads,
+                            deadline=time.monotonic() + dl / 1000.0)
+        try:
+            self.queue.submit(req)
+        except Draining:
+            self.count("draining")
+            raise
+        except Exception:               # QueueFull: overload shed, HTTP 429
+            self.count("shed")
+            raise
+        try:
+            req.wait(timeout=dl / 1000.0 + max(30.0, dl / 1000.0))
+        except DeadlineExceeded:
+            # the step may still be queued (or mid-execution on a stalled
+            # device): mark it so the batcher drops it instead of
+            # advancing the session after this thread releases its lock
+            req.abandoned = True
+            if req.error is None:
+                self.count("timeout")
+            raise
+        return req
+
+    # -- batcher-thread API ------------------------------------------------
+
+    def execute(self, req: StreamRequest, engine):
+        """Run one stream step on the device.  Returns (padded flow or
+        None, iters_used or None); all session/cache mutation happens
+        here, on the single thread that owns the device."""
+        s = req.session
+        H, W = s.bucket
+        if req.stream_op == "open":
+            fmap, cnet = engine.run_encode(s.bucket, req.image1)
+            self.store.attach_features(s, fmap, cnet, None)
+            s.last_image = req.image1
+            return None, None
+        warm = s.has_features
+        if warm:
+            # ONE encoder pass this step: frame t's maps are resident
+            fmap_p, cnet_p = s.fmap, s.cnet
+            init = warm_start_seed(s.prev_flow_lr, (H // 8, W // 8))
+            self.metrics["fnet_hits"].inc()
+        else:
+            # demoted (evicted features): cold two-encoder restart from
+            # the retained previous frame — pairwise cost, correct flow
+            fmap_p, cnet_p = engine.run_encode(s.bucket, s.last_image)
+            init = np.zeros((1, H // 8, W // 8, 2), np.float32)
+            self.metrics["fnet_misses"].inc()
+        flow, flow_lr, fmap_c, cnet_c, iters_used = engine.run_stream(
+            s.bucket, req.image1, fmap_p, cnet_p, init)
+        self.store.attach_features(s, fmap_c, cnet_c, flow_lr)
+        s.last_image = req.image1
+        s.frames += 1
+        req.warm = warm
+        req.frame = s.frames
+        self.metrics["frames"].inc()
+        return flow, iters_used

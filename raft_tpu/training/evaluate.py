@@ -236,10 +236,12 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
         if warm_start:
             # Official Sintel warm-start protocol: within a scene, frame t's
             # low-res flow — forward-projected along itself — seeds frame
-            # t+1; scene boundaries reset to a cold (zeros) start.
+            # t+1; scene boundaries reset to a cold (zeros) start.  The
+            # seed construction is shared with the streaming serving path
+            # (ops/warmstart.py builds byte-identical seeds for both).
             # Sequential by construction, so batching is rejected rather
             # than silently reordered.
-            from ..utils.frame_utils import forward_interpolate
+            from ..ops.warmstart import warm_start_seed
             if batch_size != 1:
                 raise ValueError("warm_start evaluation is sequential "
                                  "(frame t seeds frame t+1): use "
@@ -275,11 +277,8 @@ def evaluate_dataset(params, config: RAFTConfig, dataset,
                         shapes_seen.add((1,) + im1p.shape[1:])
                         trace_window.on_step(idx)
                         h8, w8 = im1p.shape[1] // 8, im1p.shape[2] // 8
-                        if (dataset.is_scene_start(idx) or prev_lr is None
-                                or prev_lr.shape[1:3] != (h8, w8)):
-                            init = np.zeros((1, h8, w8, 2), np.float32)
-                        else:
-                            init = forward_interpolate(prev_lr[0])[None]
+                        init = warm_start_seed(prev_lr, (h8, w8),
+                                               reset=dataset.is_scene_start(idx))
                         with stage("val/forward"):
                             res = warm_fn(params, jnp.asarray(im1p),
                                           jnp.asarray(im2p),

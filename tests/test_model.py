@@ -365,3 +365,89 @@ def test_scan_unroll_equivalence():
     clamped = RAFTConfig.full(iters=2, scan_unroll=8)
     out_c, _ = raft_forward(params, im1, im2, clamped)
     assert np.all(np.isfinite(np.asarray(out_c.flow)))
+
+
+# ------------------------------------------- streaming feature-reuse path --
+
+def test_forward_from_features_matches_pairwise():
+    """The streaming path's contract: encode_frame + forward_from_features
+    must reproduce raft_forward on the same frames — the cached-feature
+    advance IS the pairwise computation, just with the encoders factored
+    out.  Batch-identical ops -> exact match."""
+    from raft_tpu.models import encode_frame, forward_from_features
+
+    config = RAFTConfig.small_model(iters=3)
+    params, im1, im2 = _params_and_images(config, H=32, W=48)
+    ref, _ = raft_forward(params, im1, im2, config, train=False,
+                          all_flows=False)
+    fmap1, cnet1 = encode_frame(params, im1, config)
+    fmap2, _ = encode_frame(params, im2, config)
+    out = forward_from_features(params, fmap1, fmap2, cnet1, config)
+    np.testing.assert_allclose(np.asarray(out.flow), np.asarray(ref.flow),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.flow_lr),
+                               np.asarray(ref.flow_lr),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_forward_from_features_flow_init_matches():
+    """flow_init threads through the factored path exactly as through
+    raft_forward (the warm-start seed of the streaming advance)."""
+    from raft_tpu.models import encode_frame, forward_from_features
+
+    config = RAFTConfig.small_model(iters=2)
+    params, im1, im2 = _params_and_images(config, H=32, W=48, seed=3)
+    init = jax.random.normal(jax.random.PRNGKey(9), (1, 4, 6, 2)) * 2.0
+    ref, _ = raft_forward(params, im1, im2, config, train=False,
+                          all_flows=False, flow_init=init)
+    fmap1, cnet1 = encode_frame(params, im1, config)
+    fmap2, _ = encode_frame(params, im2, config)
+    out = forward_from_features(params, fmap1, fmap2, cnet1, config,
+                                flow_init=init)
+    np.testing.assert_allclose(np.asarray(out.flow), np.asarray(ref.flow),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_stream_step_fn_jits_and_matches():
+    """The fused one-call stream step (encode current + recurrent core):
+    jittable, one fnet pass, output within float-reassociation tolerance
+    of the pairwise run (the encoder sees batch 1 instead of the pairwise
+    2B concat, so reductions associate differently)."""
+    from raft_tpu.models import encode_frame, make_stream_step_fn
+
+    config = RAFTConfig.small_model(iters=2)
+    params, im1, im2 = _params_and_images(config, H=32, W=48, seed=5)
+    ref, _ = raft_forward(params, im1, im2, config, train=False,
+                          all_flows=False)
+    fmap1, cnet1 = encode_frame(params, im1, config)
+    step = jax.jit(make_stream_step_fn(config))
+    zeros = jnp.zeros((1, 4, 6, 2), jnp.float32)
+    flow, flow_lr, fmap2, cnet2, = step(params, im2, fmap1, cnet1, zeros)
+    scale = max(float(np.abs(np.asarray(ref.flow)).max()), 1.0)
+    diff = float(np.abs(np.asarray(flow) - np.asarray(ref.flow)).max())
+    assert diff / scale < 1e-4, (diff, scale)
+    # the returned current-frame maps equal a direct encode (cacheable)
+    fmap2_ref, cnet2_ref = encode_frame(params, im2, config)
+    np.testing.assert_allclose(np.asarray(fmap2), np.asarray(fmap2_ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cnet2), np.asarray(cnet2_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_stream_step_fn_counted_under_converge():
+    """Under an adaptive policy the stream step returns iters_used — the
+    counted-executable convention the serving engine keys on."""
+    import dataclasses
+
+    from raft_tpu.models import encode_frame, make_stream_step_fn
+
+    config = dataclasses.replace(RAFTConfig.small_model(iters=4),
+                                 iters_policy="converge:1e9:2")
+    params, im1, im2 = _params_and_images(config, H=32, W=48, seed=7)
+    fmap1, cnet1 = encode_frame(params, im1, config)
+    step = jax.jit(make_stream_step_fn(config))
+    zeros = jnp.zeros((1, 4, 6, 2), jnp.float32)
+    flow, flow_lr, _, _, iters_used = step(params, im2, fmap1, cnet1, zeros)
+    assert iters_used.shape == (1,)
+    assert int(iters_used[0]) == 2               # exited at min_iters
+    assert np.isfinite(np.asarray(flow)).all()

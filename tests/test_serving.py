@@ -289,11 +289,13 @@ def live_server():
     config = RAFTConfig.small_model(iters=1)
     params = init_raft(init_rng(), config)
     # max_wait 150ms: wide enough that two concurrent posts always coalesce,
-    # short enough that lone-request tests stay fast
+    # short enough that lone-request tests stay fast.  max_sessions=0:
+    # this fixture pins the PAIRWISE warmup grid exactly (the streaming
+    # fixture below has its own server)
     sconfig = ServeConfig(buckets=((32, 48), (64, 96)), max_batch=2,
                           batch_steps=(2,), max_wait_ms=150.0,
                           queue_depth=16, default_deadline_ms=30_000.0,
-                          port=0)
+                          port=0, max_sessions=0)
     server = FlowServer(config, params, sconfig)
     server.start()
     yield server, config, params
@@ -315,10 +317,12 @@ def test_live_warmup_compiled_one_executable_per_bucket(live_server):
     server, _, _ = live_server
     eng = server.engine
     # 2 buckets x 1 batch step: exactly one warm executable per bucket;
-    # the iters policy rides in the cache key (an executable can never be
-    # reused under a different compute policy than it was warmed with)
+    # the kind + iters policy ride in the cache key (an executable can
+    # never be reused under a different compute policy than it was warmed
+    # with, and stream/encode executables never collide with pairwise)
     assert eng.executables == 2
-    assert eng.keys() == [(32, 48, 2, "fixed"), (64, 96, 2, "fixed")]
+    assert eng.keys() == [("pair", 32, 48, 2, "fixed"),
+                          ("pair", 64, 96, 2, "fixed")]
     assert eng.compile_misses == 0
 
 
@@ -557,11 +561,13 @@ def test_live_converge_policy_end_to_end():
     # deterministic early exit (random weights never reach a small eps)
     sconfig = ServeConfig(buckets=((32, 48),), max_batch=1,
                           batch_steps=(1,), max_wait_ms=5.0, queue_depth=8,
-                          port=0, iters_policy="converge:1e9:2")
+                          port=0, iters_policy="converge:1e9:2",
+                          max_sessions=0)
     server = FlowServer(config, params, sconfig)
     server.start()
     try:
-        assert server.engine.keys() == [(32, 48, 1, "converge:1e9:2")]
+        assert server.engine.keys() == [("pair", 32, 48, 1,
+                                         "converge:1e9:2")]
         rng = np.random.RandomState(7)
         im = rng.rand(32, 48, 3).astype(np.float32)
         resp = _post_json(server, im, im)
@@ -572,6 +578,296 @@ def test_live_converge_policy_end_to_end():
             text = r.read().decode()
         assert "raft_iters_used_count 1" in text
         assert "raft_iters_mean 2" in text
+        assert server.engine.compile_misses == 0
+    finally:
+        server.stop()
+
+
+# ----------------------------------------------- streaming: session store --
+
+def test_session_store_lru_demotes_features():
+    from raft_tpu.serving import SessionStore
+
+    store = SessionStore(max_sessions=2, ttl_s=60.0)
+    a, b, c = (store.open((32, 48)) for _ in range(3))
+    for s in (a, b, c):
+        store.attach_features(s, "fmap", "cnet", None)
+    # capacity 2: attaching c demoted the LRU holder (a) — record kept
+    assert store.active_count() == 2
+    assert store.resident_count() == 3
+    assert not a.has_features and a.bucket == (32, 48)
+    assert b.has_features and c.has_features
+    # re-promoting a demotes the now-LRU b
+    store.attach_features(a, "fmap2", "cnet2", None)
+    assert a.has_features and not b.has_features and c.has_features
+
+
+def test_session_store_skips_inflight_on_demote_and_sweep():
+    from raft_tpu.serving import SessionStore
+
+    store = SessionStore(max_sessions=1, ttl_s=60.0)
+    a = store.open((32, 48))
+    store.attach_features(a, "f", "c", None)
+    with a.lock:                         # a is mid-advance
+        b = store.open((32, 48))
+        store.attach_features(b, "f", "c", None)
+        assert a.has_features            # locked: not a demotion target
+        assert store.sweep(now=time.monotonic() + 999) >= 1   # b reaped
+        assert store.get(a.id) is a      # locked: not reaped either
+    store.sweep(now=time.monotonic() + 999)
+    assert store.get(a.id) is None       # unlocked: TTL reaps it
+
+
+def test_session_store_ttl_and_record_cap():
+    from raft_tpu.serving import SessionStore
+    from raft_tpu.serving.session import RECORD_CAP_FACTOR
+
+    store = SessionStore(max_sessions=1, ttl_s=0.001)
+    ids = [store.open((32, 48)).id for _ in range(RECORD_CAP_FACTOR + 2)]
+    # records bounded: the oldest were evicted outright at the cap
+    assert store.resident_count() <= RECORD_CAP_FACTOR
+    assert store.get(ids[0]) is None
+    time.sleep(0.005)
+    store.sweep()
+    assert store.resident_count() == 0   # TTL reaped the rest
+    assert store.close(ids[-1]) is None  # already gone
+
+
+# --------------------------------------------- streaming: live server -----
+
+@pytest.fixture(scope="module")
+def stream_server():
+    """A streaming-enabled live server: one bucket, batch 1, 2 GRU
+    iterations, max_sessions=1 so eviction is exercised with only two
+    sessions."""
+    from raft_tpu.config import RAFTConfig, init_rng
+    from raft_tpu.models import init_raft
+
+    config = RAFTConfig.small_model(iters=2)
+    params = init_raft(init_rng(), config)
+    sconfig = ServeConfig(buckets=((32, 48),), max_batch=1,
+                          batch_steps=(1,), max_wait_ms=5.0,
+                          queue_depth=16, default_deadline_ms=30_000.0,
+                          port=0, max_sessions=1, session_ttl_s=600.0)
+    server = FlowServer(config, params, sconfig)
+    server.start()
+    yield server, config, params
+    server.stop()
+
+
+def _post_stream(server, payload):
+    req = urllib.request.Request(
+        server.url + "/v1/stream", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def _stream_error(server, payload):
+    try:
+        _post_stream(server, payload)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+    raise AssertionError("expected an HTTP error")
+
+
+def _frames(seed, n, hw=(32, 48)):
+    rng = np.random.RandomState(seed)
+    return [rng.rand(hw[0], hw[1], 3).astype(np.float32) for _ in range(n)]
+
+
+def test_stream_warmup_shares_cache_namespace(stream_server):
+    """Pair, encode, and stream executables are all warmed into ONE engine
+    cache, keyed by kind + policy; nothing compiles at serve time."""
+    server, _, _ = stream_server
+    assert server.engine.keys() == [("encode", 32, 48, 1, "fixed"),
+                                    ("pair", 32, 48, 1, "fixed"),
+                                    ("stream", 32, 48, 1, "fixed")]
+    assert server.engine.compile_misses == 0
+
+
+def test_stream_session_lifecycle_and_equivalence(stream_server):
+    """open -> advance x3 -> close over HTTP.  The FIRST advance (zero
+    warm-start seed) must match the pairwise /v1/flow answer on the same
+    two frames; later advances warm-start (a different, better-seeded
+    trajectory) and only their shape/meta is pinned.  Exactly ONE fnet
+    pass per streamed frame (engine counters — the acceptance criterion)."""
+    server, _, _ = stream_server
+    eng = server.engine
+    frames = _frames(30, 4)
+    enc0, str0 = eng.encode_calls, eng.stream_calls
+
+    r = _post_stream(server, {"image": frames[0].tolist()})
+    sid = r["session"]
+    assert r["frame"] == 0 and r["meta"]["bucket"] == [32, 48]
+    assert eng.encode_calls == enc0 + 1          # open: one encoder pass
+
+    r1 = _post_stream(server, {"session": sid, "image": frames[1].tolist()})
+    assert r1["frame"] == 1 and r1["meta"]["warm"] is True
+    flow1 = np.asarray(r1["flow"], np.float32)
+    assert flow1.shape == (32, 48, 2)
+    pw = _post_json(server, frames[0], frames[1])
+    np.testing.assert_allclose(flow1, np.asarray(pw["flow"], np.float32),
+                               rtol=1e-4, atol=1e-2)
+
+    for t in (2, 3):
+        rt = _post_stream(server, {"session": sid,
+                                   "image": frames[t].tolist()})
+        assert rt["frame"] == t and rt["meta"]["warm"] is True
+        assert np.isfinite(np.asarray(rt["flow"])).all()
+    # 3 advances = 3 stream calls, ZERO extra encode calls: one fnet pass
+    # per streamed frame after the first
+    assert eng.stream_calls == str0 + 3
+    assert eng.encode_calls == enc0 + 1
+    assert eng.compile_misses == 0
+
+    rc = _post_stream(server, {"op": "close", "session": sid})
+    assert rc["closed"] is True and rc["frames"] == 3
+
+
+def test_stream_eviction_falls_back_cold_with_correct_flow(stream_server):
+    """max_sessions=1: opening session B evicts A's features.  A's next
+    advance must still answer — cold two-encoder restart, flow equal to
+    the pairwise answer on the same frames — and the eviction/cold
+    counters must say so."""
+    server, _, _ = stream_server
+    eng = server.engine
+    fa, fb = _frames(31, 3), _frames(32, 2)
+
+    sa = _post_stream(server, {"image": fa[0].tolist()})["session"]
+    r1 = _post_stream(server, {"session": sa, "image": fa[1].tolist()})
+    assert r1["meta"]["warm"] is True
+    sb = _post_stream(server, {"image": fb[0].tolist()})["session"]
+    _post_stream(server, {"session": sb, "image": fb[1].tolist()})
+
+    enc0 = eng.encode_calls
+    r2 = _post_stream(server, {"session": sa, "image": fa[2].tolist()})
+    assert r2["meta"]["warm"] is False           # demoted -> cold restart
+    assert eng.encode_calls == enc0 + 1          # re-encoded the prev frame
+    pw = _post_json(server, fa[1], fa[2])
+    np.testing.assert_allclose(np.asarray(r2["flow"], np.float32),
+                               np.asarray(pw["flow"], np.float32),
+                               rtol=1e-4, atol=1e-2)
+    with urllib.request.urlopen(server.url + "/metrics") as r:
+        text = r.read().decode()
+    assert 'raft_stream_evictions_total{reason="lru"}' in text
+    assert "raft_stream_fnet_cache_misses_total" in text
+    assert server.engine.compile_misses == 0
+    for s in (sa, sb):
+        _post_stream(server, {"op": "close", "session": s})
+
+
+def test_stream_metrics_and_healthz(stream_server):
+    server, _, _ = stream_server
+    frames = _frames(33, 2)
+    sid = _post_stream(server, {"image": frames[0].tolist()})["session"]
+    _post_stream(server, {"session": sid, "image": frames[1].tolist()})
+    with urllib.request.urlopen(server.url + "/healthz") as r:
+        h = json.loads(r.read())
+    assert h["stream"]["max_sessions"] == 1
+    assert h["stream"]["sessions_active"] >= 1
+    with urllib.request.urlopen(server.url + "/metrics") as r:
+        text = r.read().decode()
+    for name in ("raft_stream_sessions_active",
+                 "raft_stream_sessions_resident",
+                 "raft_stream_opens_total",
+                 "raft_stream_frames_total",
+                 "raft_stream_fnet_cache_hits_total"):
+        assert name in text, name
+    _post_stream(server, {"op": "close", "session": sid})
+
+
+def test_stream_error_statuses(stream_server):
+    server, _, _ = stream_server
+    im = np.zeros((32, 48, 3)).tolist()
+    # unknown session -> 404
+    st, body = _stream_error(server, {"session": "deadbeef", "image": im})
+    assert st == 404 and "unknown session" in body["error"]
+    st, _ = _stream_error(server, {"op": "close", "session": "deadbeef"})
+    assert st == 404
+    # image missing -> 400
+    st, body = _stream_error(server, {"op": "open"})
+    assert st == 400 and "image" in body["error"]
+    # bad op -> 400
+    st, body = _stream_error(server, {"op": "advnce", "session": "x",
+                                      "image": im})
+    assert st == 400 and "op" in body["error"]
+    # unroutable first frame -> 400
+    big = np.zeros((72, 104, 3)).tolist()
+    st, body = _stream_error(server, {"image": big})
+    assert st == 400 and "bucket" in body["error"]
+    # busy session (a frame already in flight) -> 409
+    sid = _post_stream(server, {"image": im})["session"]
+    sess = server.streams.store.get(sid)
+    with sess.lock:                      # simulate an in-flight frame
+        st, body = _stream_error(server, {"session": sid, "image": im})
+    assert st == 409 and "in flight" in body["error"]
+    _post_stream(server, {"op": "close", "session": sid})
+
+
+def test_stream_disabled_server_rejects(live_server):
+    """The pairwise fixture runs with --max-sessions 0: /v1/stream must
+    answer 400 with a pointer, not 404-the-path or a crash."""
+    server, _, _ = live_server
+    st, body = _stream_error(server, {"image": np.zeros((32, 48, 3)).tolist()})
+    assert st == 400 and "disabled" in body["error"]
+
+
+def test_stream_npz_round_trip(stream_server):
+    server, _, _ = stream_server
+    frames = _frames(34, 2)
+
+    def post_npz(**arrays):
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        req = urllib.request.Request(
+            server.url + "/v1/stream", data=buf.getvalue(),
+            headers={"Content-Type": "application/octet-stream",
+                     "Accept": "application/octet-stream"})
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+            return np.load(io.BytesIO(r.read()))
+
+    with post_npz(image=frames[0]) as z:
+        sid = str(z["session"])
+        assert int(z["frame"]) == 0
+    with post_npz(op=np.asarray("advance"), session=np.asarray(sid),
+                  image=frames[1]) as z:
+        assert z["flow"].shape == (32, 48, 2)
+        assert np.isfinite(z["flow"]).all()
+        assert bool(z["warm"]) is True
+    _post_stream(server, {"op": "close", "session": sid})
+
+
+def test_stream_converge_policy_end_to_end():
+    """Streaming under --iters-policy: policy-keyed pair/encode/stream
+    executables, per-advance iters_used in meta and the raft_iters_used
+    histogram, zero compile misses."""
+    from raft_tpu.config import RAFTConfig, init_rng
+    from raft_tpu.models import init_raft
+
+    config = RAFTConfig.small_model(iters=3)
+    params = init_raft(init_rng(), config)
+    sconfig = ServeConfig(buckets=((32, 48),), max_batch=1,
+                          batch_steps=(1,), max_wait_ms=5.0, queue_depth=8,
+                          port=0, iters_policy="converge:1e9:2",
+                          max_sessions=2)
+    server = FlowServer(config, params, sconfig)
+    server.start()
+    try:
+        assert server.engine.keys() == [
+            ("encode", 32, 48, 1, "converge:1e9:2"),
+            ("pair", 32, 48, 1, "converge:1e9:2"),
+            ("stream", 32, 48, 1, "converge:1e9:2")]
+        frames = _frames(35, 3)
+        sid = _post_stream(server, {"image": frames[0].tolist()})["session"]
+        for t in (1, 2):
+            r = _post_stream(server, {"session": sid,
+                                      "image": frames[t].tolist()})
+            assert r["meta"]["iters_used"] == 2   # exited at min_iters
+        with urllib.request.urlopen(server.url + "/metrics") as r:
+            text = r.read().decode()
+        assert "raft_iters_used_count 2" in text
         assert server.engine.compile_misses == 0
     finally:
         server.stop()

@@ -316,3 +316,27 @@ def test_cost_analysis_and_flops_report_live():
     flops, msg = flops_report(fn, jnp.ones((8, 8), jnp.float32))
     assert "flops" in msg
     assert isinstance(flops, float)       # a number or nan, never a raise
+
+
+def test_warm_start_seed_matches_inline_protocol():
+    """The shared seed helper (ops/warmstart.py) is byte-compatible with
+    the logic it was factored out of (training/evaluate.py's inline
+    branch): zeros on reset / no previous / grid mismatch, else the
+    forward-projected previous flow."""
+    from raft_tpu.ops.warmstart import warm_start_seed
+    from raft_tpu.utils.frame_utils import forward_interpolate
+
+    rng = np.random.RandomState(0)
+    prev = (rng.randn(1, 6, 8, 2) * 3).astype(np.float32)
+
+    np.testing.assert_array_equal(warm_start_seed(None, (6, 8)),
+                                  np.zeros((1, 6, 8, 2), np.float32))
+    np.testing.assert_array_equal(warm_start_seed(prev, (6, 8), reset=True),
+                                  np.zeros((1, 6, 8, 2), np.float32))
+    np.testing.assert_array_equal(warm_start_seed(prev, (5, 8)),
+                                  np.zeros((1, 5, 8, 2), np.float32))
+    out = warm_start_seed(prev, (6, 8))
+    np.testing.assert_array_equal(out, forward_interpolate(prev[0])[None])
+    assert out.shape == (1, 6, 8, 2) and out.dtype == np.float32
+    # 3-dim previous flow accepted (the [h, w, 2] convention)
+    np.testing.assert_array_equal(warm_start_seed(prev[0], (6, 8)), out)

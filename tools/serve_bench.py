@@ -20,6 +20,17 @@ and appended to ``BENCH_serving.json`` (one JSON object per line).
 ``--smoke`` is the CI fast path: tiny model, tiny bucket, a few dozen
 requests; exits nonzero if the batcher never coalesced (occupancy <= 1)
 or anything recompiled after warmup.
+
+``--video`` switches to the streaming-workload probe: ``--sessions``
+synthetic N-frame sequences (``--frames``) each run twice over the SAME
+frames — pairwise through ``/v1/flow`` (the cold baseline: two encoder
+passes + cold iterations per pair) and sessionfully through
+``/v1/stream`` (cached features + warm-started recurrence).  The record
+reports pairs/sec for both arms, the encoder-pass saving (from the
+``raft_stream_fnet_cache_*`` counters), and iters p50/p95 cold vs
+streamed (phase-diffed ``raft_iters_used`` histograms).  With ``--smoke``
+it asserts zero recompiles under the watchdog and non-zero fnet cache
+hits — the CI streaming gate.
 """
 
 from __future__ import annotations
@@ -99,6 +110,116 @@ class Client:
             self.results.append((status, time.monotonic() - t0))
 
 
+def diff_prom(before, after):
+    """after - before per series: the metrics one phase contributed."""
+    return {k: v - before.get(k, 0.0) for k, v in after.items()}
+
+
+def scrape(host, port):
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    conn.request("GET", "/metrics")
+    prom = parse_prom(conn.getresponse().read().decode())
+    conn.close()
+    return prom
+
+
+def make_session_frames(h, w, n, seed, shift=6):
+    """A synthetic constant-velocity sequence: a procedural texture
+    (data/synthetic.py octaves — image-like statistics, unlike white
+    noise) translated ``shift`` px per frame plus mild per-frame noise.
+    Consecutive frames share content (what feature reuse assumes) and the
+    motion is predictable (what warm start assumes); the default shift is
+    large enough that a COLD converge:* run needs several iterations to
+    chase it — the regime where the warm-started seed measurably shortens
+    the recurrence (TUNING.md round 8 ladder)."""
+    from raft_tpu.data.synthetic import SyntheticFlowDataset
+    base = SyntheticFlowDataset(size=(h, w), length=1, seed=seed)[0][0]
+    rng = np.random.RandomState(seed)
+    frames = []
+    for t in range(n):
+        f = np.roll(base, shift=shift * t, axis=1)
+        f = np.clip(f + rng.randn(h, w, 3).astype(np.float32) * 0.01, 0, 1)
+        frames.append(f)
+    return frames
+
+
+def _npz(**arrays):
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+class StreamClient(Client):
+    """Keep-alive client speaking /v1/stream npz bodies."""
+
+    def post(self, path, body):
+        t0 = time.monotonic()
+        try:
+            self.conn.request(
+                "POST", path, body=body,
+                headers={"Content-Type": "application/octet-stream",
+                         "Accept": "application/octet-stream"})
+            resp = self.conn.getresponse()
+            payload = resp.read()
+            status = resp.status
+        except Exception:
+            self.conn.close()
+            self.conn = http.client.HTTPConnection(
+                self.conn.host, self.conn.port, timeout=60)
+            status, payload = -1, b""
+        with self.lock:
+            self.results.append((status, time.monotonic() - t0))
+        return status, payload
+
+    def run_sequence(self, frames):
+        """open -> advance x (n-1) -> close; only advances land in the
+        shared results list (they are the pairs)."""
+        saved = self.results
+        self.results = []                # opens/closes: not pairs
+        st, payload = self.post("/v1/stream", _npz(image=frames[0]))
+        self.results = saved
+        if st != 200:
+            with self.lock:
+                self.results.append((st, 0.0))
+            return
+        with np.load(io.BytesIO(payload)) as z:
+            sid = str(z["session"])
+        for f in frames[1:]:
+            self.post("/v1/stream", _npz(op=np.asarray("advance"),
+                                         session=np.asarray(sid), image=f))
+        saved = self.results
+        self.results = []
+        self.post("/v1/stream", _npz(op=np.asarray("close"),
+                                     session=np.asarray(sid)))
+        self.results = saved
+
+    def run_pairwise(self, frames):
+        for a, b in zip(frames[:-1], frames[1:]):
+            self.post("/v1/flow", _npz(image1=a, image2=b))
+
+
+def run_video(host, port, sequences, stream):
+    """Drive every sequence concurrently (one worker per session);
+    returns (results, elapsed)."""
+    results, lock = [], threading.Lock()
+
+    def worker(frames):
+        c = StreamClient(host, port, b"", results, lock)
+        if stream:
+            c.run_sequence(frames)
+        else:
+            c.run_pairwise(frames)
+
+    threads = [threading.Thread(target=worker, args=(fr,))
+               for fr in sequences]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, time.monotonic() - t0
+
+
 def run_closed(host, port, body, clients, total):
     results, lock = [], threading.Lock()
     remaining = [total]
@@ -157,6 +278,121 @@ def run_open(host, port, body, clients, total, rate, seed=0):
     return results, time.monotonic() - t0
 
 
+def _iters_summary(prom_diff):
+    """Per-phase iterations-used summary from a phase-diffed scrape."""
+    cnt = prom_diff.get("raft_iters_used_count", 0)
+    if not cnt:
+        return None
+    return {"count": int(cnt),
+            "mean": round(prom_diff.get("raft_iters_used_sum", 0.0) / cnt, 3),
+            "p50": hist_percentile(prom_diff, "raft_iters_used", 0.50),
+            "p95": hist_percentile(prom_diff, "raft_iters_used", 0.95)}
+
+
+def run_video_bench(args, host, port, server, config) -> int:
+    """The --video arms: cold pairwise then streamed, SAME frames, with
+    per-phase metric diffs; appends one record and (with --smoke) gates
+    on zero recompiles + non-zero fnet cache hits."""
+    h, w = args.size
+    sessions = args.sessions or args.clients
+    seqs = [make_session_frames(h, w, args.frames, seed=100 + i,
+                                shift=args.shift)
+            for i in range(sessions)]
+    pairs = sessions * (args.frames - 1)
+    print(f"[bench] video: {sessions} session(s) x {args.frames} frames "
+          f"({pairs} pairs/arm, {args.shift}px/frame) at {h}x{w}")
+
+    prom0 = scrape(host, port)
+    cold_res, cold_s = run_video(host, port, seqs, stream=False)
+    prom_cold = scrape(host, port)
+    stream_res, stream_s = run_video(host, port, seqs, stream=True)
+    prom_stream = scrape(host, port)
+    if server is not None:
+        server.stop()
+    cold_d = diff_prom(prom0, prom_cold)
+    stream_d = diff_prom(prom_cold, prom_stream)
+
+    def statuses(results):
+        by = {}
+        for st, _ in results:
+            by[str(st)] = by.get(str(st), 0) + 1
+        return by
+
+    def phase(results, elapsed, d):
+        ok = sum(1 for st, _ in results if st == 200)
+        return {"pairs_per_sec": round(ok / elapsed, 3) if elapsed else 0.0,
+                "elapsed_s": round(elapsed, 3), "statuses": statuses(results),
+                "iters_used": _iters_summary(d)}
+
+    advances = stream_d.get("raft_stream_frames_total", 0)
+    opens = stream_d.get("raft_stream_opens_total", 0)
+    hits = stream_d.get("raft_stream_fnet_cache_hits_total", 0)
+    misses = stream_d.get("raft_stream_fnet_cache_misses_total", 0)
+    evictions = sum(v for k, v in stream_d.items()
+                    if k.startswith("raft_stream_evictions_total"))
+    # encoder-pass arithmetic: an advance encodes the current frame (1),
+    # an open encodes the first frame (1), a cold restart re-encodes the
+    # previous frame (1 more); the pairwise arm costs 2 fnet passes per
+    # pair on the same frames
+    fnet_passes = advances + opens + misses
+    stream_rec = phase(stream_res, stream_s, stream_d)
+    stream_rec.update({
+        "sessions": sessions,
+        "fnet_cache_hits": int(hits), "fnet_cache_misses": int(misses),
+        "evictions": int(evictions),
+        "fnet_passes_per_pair": round(fnet_passes / advances, 3)
+        if advances else None,
+        "encoder_passes_saved_pct": round(
+            100.0 * (1.0 - fnet_passes / (2.0 * advances)), 1)
+        if advances else None,
+    })
+    rec = {
+        "bench": "serving", "mode": "video",
+        "sessions": sessions, "frames_per_session": args.frames,
+        "pairs_per_arm": pairs, "image_hw": [h, w],
+        "shift_px_per_frame": args.shift,
+        "iters_policy": (args.iters_policy or "fixed") if not args.url
+        else None,
+        "pairwise": phase(cold_res, cold_s, cold_d),
+        "stream": stream_rec,
+        "compile_misses_after_warmup": int(
+            prom_stream.get("raft_serving_compile_cache_misses_total", -1)),
+    }
+    from raft_tpu.telemetry import run_manifest
+    rec["manifest"] = run_manifest(config=config, mode="serve_bench")
+    print(json.dumps(rec, indent=2))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"[bench] appended to {args.out}")
+
+    if args.smoke:
+        problems = []
+        bad = {k: v for k, v in statuses(cold_res + stream_res).items()
+               if k != "200"}
+        if bad:
+            problems.append(f"non-200 responses: {bad}")
+        if not hits:
+            problems.append("no fnet cache hits: streamed advances never "
+                            "reused the previous frame's features")
+        if rec["compile_misses_after_warmup"] != 0:
+            problems.append(f"{rec['compile_misses_after_warmup']} "
+                            f"compile(s) after warmup")
+        recompiles = prom_stream.get("raft_serving_xla_recompiles_total")
+        if not args.url:
+            if recompiles is None:
+                problems.append("watchdog recompile counter missing from "
+                                "/metrics (RAFT_TPU_WATCHDOGS not live?)")
+            elif recompiles != 0:
+                problems.append(f"{int(recompiles)} XLA recompile(s) after "
+                                f"warmup while streaming")
+        if problems:
+            print("[bench] SMOKE FAIL: " + "; ".join(problems))
+            return 1
+        print("[bench] SMOKE PASS")
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description="serving load generator")
     p.add_argument("--url", default=None,
@@ -176,6 +412,10 @@ def main() -> int:
     p.add_argument("--queue-depth", type=int, default=64)
     p.add_argument("--deadline-ms", type=float, default=10000.0)
     p.add_argument("--small", action="store_true", default=None)
+    p.add_argument("--load", default=None,
+                   help="checkpoint (.npz/.pth) for the in-process server; "
+                        "default: random init (timing-only numbers — "
+                        "converge policies need trained weights to exit)")
     p.add_argument("--iters", type=int, default=None)
     p.add_argument("--iters-policy", default=None, metavar="POLICY",
                    help="serve under an iteration policy ('fixed' or "
@@ -184,9 +424,29 @@ def main() -> int:
                         "record from the raft_iters_used histogram")
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--out", default="BENCH_serving.json")
+    p.add_argument("--video", action="store_true",
+                   help="streaming-workload probe: per-session frame "
+                        "sequences through /v1/flow (cold pairwise "
+                        "baseline) then /v1/stream (cached features + "
+                        "warm start) — reports pairs/sec, encoder-pass "
+                        "saving, and iters cold vs streamed")
+    p.add_argument("--frames", type=int, default=8,
+                   help="video mode: frames per session (pairs = frames-1)")
+    p.add_argument("--sessions", type=int, default=None,
+                   help="video mode: concurrent sessions (default: "
+                        "--clients)")
+    p.add_argument("--shift", type=int, default=6,
+                   help="video mode: constant velocity of the synthetic "
+                        "sequences, px/frame (larger = harder cold "
+                        "chase = more warm-start iteration saving)")
+    p.add_argument("--max-sessions", type=int, default=64,
+                   help="in-process server: streaming session bound "
+                        "(ServeConfig.max_sessions)")
     p.add_argument("--smoke", action="store_true",
                    help="CI fast path: tiny model + a few requests, "
-                        "asserts coalescing and zero recompiles")
+                        "asserts coalescing and zero recompiles (with "
+                        "--video: zero recompiles + non-zero fnet cache "
+                        "hits on a 4-frame session drive)")
     args = p.parse_args()
 
     if args.smoke:
@@ -195,6 +455,9 @@ def main() -> int:
         args.size = (32, 48)
         args.requests = min(args.requests, 24)
         args.clients = min(args.clients, 4)
+        if args.video:
+            args.frames = min(args.frames, 4)
+            args.sessions = args.sessions or 2
         args.cpu = True
         if args.iters_policy is None and not args.url:
             # the smoke exercises the adaptive path by default: counted
@@ -235,12 +498,17 @@ def main() -> int:
         config = (RAFTConfig.small_model(iters=args.iters)
                   if args.small else
                   RAFTConfig.full(iters=args.iters or 12))
-        params = init_raft(init_rng(), config)
+        if args.load:
+            from raft_tpu.convert import load_checkpoint_auto
+            params = load_checkpoint_auto(args.load)
+        else:
+            params = init_raft(init_rng(), config)
         sconfig = ServeConfig(
             buckets=parse_buckets(bucket_spec), max_batch=args.max_batch,
             max_wait_ms=args.max_wait_ms, queue_depth=args.queue_depth,
             default_deadline_ms=args.deadline_ms, port=0,
-            iters_policy=args.iters_policy)
+            iters_policy=args.iters_policy,
+            max_sessions=args.max_sessions if args.video else 0)
         server = FlowServer(config, params, sconfig, verbose=False)
         t0 = time.monotonic()
         server.start()
@@ -248,6 +516,10 @@ def main() -> int:
               f"{time.monotonic() - t0:.1f}s  buckets={bucket_spec}  "
               f"max_batch={args.max_batch}  url={server.url}")
         host, port = sconfig.host, server.port
+
+    if args.video:
+        return run_video_bench(args, host, port, server,
+                               None if args.url else config)
 
     if args.mode == "closed":
         results, elapsed = run_closed(host, port, body,
