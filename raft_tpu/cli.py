@@ -192,6 +192,13 @@ def _build_parser() -> argparse.ArgumentParser:
                         "the stage preset's; shorten for failure-recovery "
                         "drills — multi-host training resumes from the "
                         "latest checkpoint after a process failure)")
+    p.add_argument("--keep-checkpoints", type=int, default=None,
+                   metavar="N",
+                   help="train mode: retain only the newest N step-"
+                        "numbered checkpoints — the oldest are pruned "
+                        "AFTER each successful atomic save (default: keep "
+                        "everything); resume skips a corrupt/truncated "
+                        "newest file with a warning instead of crashing")
     p.add_argument("--log-every", type=int, default=None, metavar="N",
                    help="train mode: metrics.jsonl/console logging period")
     p.add_argument("--train-size", type=int, nargs=2, default=None,
@@ -299,6 +306,28 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="serve mode: streaming sessions idle longer than "
                         "T seconds are reaped; advancing a reaped id is a "
                         "404 (the client reopens)")
+    # chaos + self-healing (SERVING.md "Failure modes & degradation
+    # ladder"): fault injection is a first-class drill surface, and the
+    # breaker/supervisor knobs gate what /healthz reports
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="serve mode: ARM FAULT INJECTION (drills only) — "
+                        "a seeded spec like 'seed=11,engine_error=0.05,"
+                        "latency=0.02,latency_ms=150,nan=0.03,session=0.05,"
+                        "kill=0.01' (serving/faults.py; RAFT_TPU_CHAOS is "
+                        "the env equivalent).  Injected faults are counted "
+                        "in raft_fault_injected_total{arm=}")
+    p.add_argument("--breaker-window", type=int, default=64, metavar="N",
+                   help="serve mode: circuit-breaker sliding window (device "
+                        "calls); error rate over it >= the threshold opens "
+                        "the breaker (shed 503 + Retry-After).  0 disables")
+    p.add_argument("--breaker-threshold", type=float, default=0.5,
+                   metavar="R",
+                   help="serve mode: error-rate fraction that opens the "
+                        "breaker (in (0, 1])")
+    p.add_argument("--breaker-cooldown-s", type=float, default=5.0,
+                   metavar="T",
+                   help="serve mode: seconds the breaker stays open before "
+                        "half-open probes test recovery")
     return p
 
 

@@ -263,6 +263,11 @@ class TrainConfig:
     log_every: int = 100
     ckpt_every: int = 5000
     ckpt_dir: str = "checkpoints"
+    # Retention: keep only the newest N step-numbered checkpoints, pruning
+    # the oldest AFTER each successful atomic save (None = keep all).
+    # Resume pairs with this: restore_latest_with_fallback skips a
+    # corrupt/truncated newest file instead of crashing.
+    keep_checkpoints: Optional[int] = None
 
     @staticmethod
     def for_stage(stage: str, **overrides) -> "TrainConfig":

@@ -9,14 +9,18 @@ sessionful streaming-video path (``/v1/stream``: cross-frame feature
 reuse + warm-started early exit, session.py/stream.py).
 """
 
-from .batcher import MicroBatcher
+from .batcher import (BatcherCrashed, MicroBatcher, NonFiniteOutput,
+                      PoisonedRequest)
+from .breaker import BreakerOpen, CircuitBreaker
 from .config import ServeConfig, default_batch_steps, parse_buckets
 from .engine import InferenceEngine
+from .faults import (BatcherKilled, ChaosSpec, FaultInjected, FaultInjector,
+                     make_injector, parse_chaos_spec)
 from .metrics import (Counter, Gauge, Histogram, Registry,
                       make_serving_metrics, make_stream_metrics)
 from .queue import (DeadlineExceeded, Draining, QueueFull, RejectedError,
                     Request, RequestQueue)
-from .server import FlowServer, serve_cli
+from .server import BatcherSupervisor, FlowServer, serve_cli
 from .session import Session, SessionStore
 from .stream import (SessionBusy, StreamCoordinator, StreamRequest,
                      UnknownSession)

@@ -1,4 +1,4 @@
-"""Dynamic micro-batcher: coalesce, pad, execute, deliver.
+"""Dynamic micro-batcher: coalesce, pad, execute, deliver — and contain.
 
 One daemon thread pulls same-bucket FIFO runs from the admission queue
 (``RequestQueue.take_batch``: full batch, aged ``max_wait_ms``, or drain —
@@ -11,6 +11,23 @@ original resolution, and resolves the waiting handler threads.
 The engine is injected as a callable ``run(bucket, im1, im2) -> flow`` so
 tests can drive the batching policy with a stub (slow / counting / failing)
 engine and never touch a compile.
+
+Failure containment (SERVING.md "Failure modes & degradation ladder"):
+
+* **Non-finite sentinel** — every flow output is row-checked host-side;
+  a NaN/Inf row fails only ITS request (HTTP 500, status ``poisoned``,
+  ``raft_nonfinite_outputs_total``) while co-batched neighbors resolve.
+* **Poisoned-batch bisection** — an engine exception is first retried
+  (transient device errors heal under backoff), then the batch is
+  split-and-retried so only the guilty request fails with
+  :class:`PoisonedRequest`; innocents succeed.  Sub-groups pad to the
+  declared batch steps, so bisection never compiles a new shape.  Total
+  engine calls per batch are capped by a budget (~2x the group size per
+  attempt), so a sick engine cannot trap the thread in retry storms.
+* **Crash surface** — an exception escaping the loop itself fails any
+  in-flight requests and is handed to the server's supervisor, which
+  restarts the thread (``server.BatcherSupervisor``).  KeyboardInterrupt/
+  SystemExit are re-raised after failing the batch, never swallowed.
 
 Streaming steps (serving/stream.py) share this thread — ONE owner of the
 device — but execute per session via the injected ``stream_fn``: the
@@ -30,11 +47,30 @@ from ..data.pipeline import unpad
 from .queue import DeadlineExceeded, RequestQueue
 
 
+class PoisonedRequest(RuntimeError):
+    """The bisected-guilty request of a failing batch: the engine fails
+    whenever this request is present, after retries (HTTP 500, error
+    class ``poisoned``)."""
+
+
+class NonFiniteOutput(RuntimeError):
+    """The engine produced NaN/Inf flow for this request's row (HTTP 500,
+    error class ``poisoned``) — inputs were validated at the HTTP edge
+    (http.py), so a non-finite *output* is an engine-side failure."""
+
+
+class BatcherCrashed(RuntimeError):
+    """The batcher thread died while this request was in flight; the
+    supervisor restarts the loop — retry the request."""
+
+
 class MicroBatcher:
     def __init__(self, queue: RequestQueue, run_fn: Callable,
                  pad_batch_to: Callable[[int], int], max_batch: int,
                  max_wait_ms: float, metrics: Optional[Dict] = None,
-                 stream_fn: Optional[Callable] = None):
+                 stream_fn: Optional[Callable] = None,
+                 breaker=None, faults=None, retries: int = 1,
+                 retry_backoff_s: float = 0.02, on_crash=None):
         self.queue = queue
         self.run_fn = run_fn
         # streaming steps (serving/stream.py) ride the same queue and the
@@ -46,13 +82,27 @@ class MicroBatcher:
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1000.0
         self.metrics = metrics or {}
+        self.breaker = breaker            # CircuitBreaker or None
+        self.faults = faults              # FaultInjector or None (chaos)
+        self.retries = retries            # same-group retries before bisect
+        self.retry_backoff_s = retry_backoff_s
+        self.on_crash = on_crash          # supervisor hook: (exception) ->
         self.batches = 0
         self.served = 0
         self.timed_out = 0
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="raft-serving-batcher")
+        self._inflight_batch = None       # the popped-but-unresolved batch
+        self._thread = self._new_thread()
+
+    def _new_thread(self) -> threading.Thread:
+        return threading.Thread(target=self._thread_main, daemon=True,
+                                name="raft-serving-batcher")
 
     def start(self) -> None:
+        self._thread.start()
+
+    def restart(self) -> None:
+        """Supervisor hook: bring up a fresh loop thread after a crash."""
+        self._thread = self._new_thread()
         self._thread.start()
 
     def join(self, timeout: Optional[float] = None) -> None:
@@ -102,12 +152,22 @@ class MicroBatcher:
         try:
             flow, iters_used = self.stream_fn(r)
         except BaseException as e:
+            # the stream executor already retried cold internally; a step
+            # failing here is terminal for this frame.  Never swallow a
+            # shutdown signal: fail the request, then let KeyboardInterrupt
+            # / SystemExit keep propagating.
+            if self.breaker is not None:
+                self.breaker.record(False)
             self._observe("requests", "error", 1)
             r.fail(e)
+            if not isinstance(e, Exception):
+                raise
             return
         finally:
             self._observe("inflight", -1)
             self._observe("batch_latency", time.monotonic() - t0)
+        if self.breaker is not None:
+            self.breaker.record(True)
         r.batch_real = r.batch_padded = 1
         if iters_used is not None:
             r.iters_used = int(np.asarray(iters_used).reshape(-1)[0])
@@ -123,6 +183,14 @@ class MicroBatcher:
             self._observe("pairs", 1.0)
             r.resolve(unpad(flow[:1], r.pads)[0])
 
+    # -- pairwise execution: retry -> bisect -> sentinel -------------------
+
+    def _bisect_budget(self, n: int) -> int:
+        """Engine-call cap for one batch's recovery: a full binary
+        bisection of an all-poisoned group of n costs 2n-1 calls; allow
+        that at every retry attempt, nothing more."""
+        return (self.retries + 1) * 2 * n
+
     def _execute(self, batch) -> None:
         if getattr(batch[0], "stream_op", None) is not None:
             for r in batch:
@@ -130,42 +198,115 @@ class MicroBatcher:
             return
         n = len(batch)
         padded = self.pad_batch_to(min(n, self.max_batch))
-        im1 = np.concatenate([r.image1 for r in batch]
-                             + [batch[-1].image1] * (padded - n))
-        im2 = np.concatenate([r.image2 for r in batch]
-                             + [batch[-1].image2] * (padded - n))
         self._observe("batch_size", float(n))
         self._observe("batch_occupancy", n / padded)
         self._observe("inflight", 1)
         t0 = time.monotonic()
         try:
-            flows = self.run_fn(batch[0].bucket, im1, im2)
-        except BaseException as e:
-            for r in batch:
-                self._observe("requests", "error", 1)
-                r.fail(e)
-            return
+            budget = [self._bisect_budget(n)]
+            self._run_group(batch, budget)
         finally:
             self._observe("inflight", -1)
             self._observe("batch_latency", time.monotonic() - t0)
+
+    def _run_group(self, group, budget) -> None:
+        """Run one same-bucket group; on persistent engine failure, split
+        and retry halves so only the guilty request(s) fail.  ``budget``
+        is the batch-wide engine-call allowance (mutable 1-list)."""
+        n = len(group)
+        padded = self.pad_batch_to(min(n, self.max_batch))
+        im1 = np.concatenate([r.image1 for r in group]
+                             + [group[-1].image1] * (padded - n))
+        im2 = np.concatenate([r.image2 for r in group]
+                             + [group[-1].image2] * (padded - n))
+        out, err, attempts = None, None, 0
+        while attempts <= self.retries and budget[0] > 0:
+            attempts += 1
+            budget[0] -= 1
+            try:
+                out = self.run_fn(group[0].bucket, im1, im2)
+            except Exception as e:
+                # transient device errors heal under a short backoff;
+                # persistent ones fall through to bisection below
+                if self.breaker is not None:
+                    self.breaker.record(False)
+                err = e
+                if attempts <= self.retries and budget[0] > 0:
+                    time.sleep(self.retry_backoff_s)
+                continue
+            except BaseException as e:
+                # shutdown (KeyboardInterrupt/SystemExit): fail the group
+                # so no handler hangs, then keep propagating — swallowing
+                # it here would eat Ctrl-C
+                for r in group:
+                    self._observe("requests", "error", 1)
+                    r.fail(e)
+                raise
+            if self.breaker is not None:
+                self.breaker.record(True)
+            err = None
+            break
+        if out is None and err is None:
+            # budget ran dry before this sub-group got a single attempt
+            err = RuntimeError("bisection budget exhausted before this "
+                               "sub-group could execute")
+        if err is not None:
+            if n == 1 and attempts:
+                # bisected down to the guilty request: the 'poisoned'
+                # error class — co-batched neighbors already succeeded
+                self._observe("requests", "poisoned", 1)
+                group[0].fail(PoisonedRequest(
+                    f"request {group[0].id} poisons its batch: engine "
+                    f"failed after {attempts} attempt(s): {err}"))
+                return
+            if budget[0] <= 0:
+                # retry budget exhausted mid-bisection: the engine is
+                # sick, not one request — fail the remainder as plain
+                # errors (the breaker is already counting these)
+                for r in group:
+                    self._observe("requests", "error", 1)
+                    r.fail(err)
+                return
+            mid = n // 2
+            self._run_group(group[:mid], budget)
+            self._run_group(group[mid:], budget)
+            return
         # converge-policy engines return (flows, per-row iters_used); only
         # REAL rows are accounted — padding rows repeat the last request
         # and would skew the raft_iters_used distribution
         iters_used = None
+        flows = out
         if isinstance(flows, tuple):
             flows, iters_used = flows
+        flows = np.asarray(flows)
+        # non-finite OUTPUT sentinel: inputs were validated at the HTTP
+        # edge, so a NaN/Inf row here is the engine's failure — fail that
+        # row alone, its neighbors are fine (per-sample independence)
+        row_ok = np.isfinite(flows[:n].reshape(n, -1)).all(axis=1)
         now = time.monotonic()
-        for i, r in enumerate(batch):
+        served = 0
+        for i, r in enumerate(group):
             r.batch_real, r.batch_padded = n, padded
             if iters_used is not None:
                 r.iters_used = int(iters_used[i])
                 self._observe("iters_used", float(iters_used[i]))
             self._observe("queue_latency", r.dequeued_at - r.enqueued_at)
             self._observe("request_latency", now - r.enqueued_at)
-            self._observe("requests", "ok", 1)
-            self.served += 1
-            r.resolve(unpad(flows[i:i + 1], r.pads)[0])
-        self._observe("pairs", float(n))
+            if row_ok[i]:
+                self._observe("requests", "ok", 1)
+                self.served += 1
+                served += 1
+                r.resolve(unpad(flows[i:i + 1], r.pads)[0])
+            else:
+                self._observe("nonfinite")
+                self._observe("requests", "poisoned", 1)
+                r.fail(NonFiniteOutput(
+                    f"non-finite flow output for request {r.id} "
+                    f"(poisoned row in an otherwise-healthy batch)"))
+        if served:
+            self._observe("pairs", float(served))
+
+    # -- the loop + its crash surface --------------------------------------
 
     def _loop(self) -> None:
         while True:
@@ -176,4 +317,31 @@ class MicroBatcher:
                 return
             if batch:
                 self.batches += 1
+                # cleared only on the success path: an exception escaping
+                # here must leave the batch visible to _thread_main's
+                # crash handler (it fails whatever is not yet done)
+                self._inflight_batch = batch
+                if self.faults is not None:
+                    self.faults.maybe_kill()       # chaos: thread-death arm
                 self._execute(batch)
+                self._inflight_batch = None
+
+    def _thread_main(self) -> None:
+        try:
+            self._loop()
+        except BaseException as e:
+            # the crash surface: fail whatever was popped but unresolved
+            # (handler threads must never hang on a dead batcher), then
+            # hand an Exception to the supervisor for restart; shutdown
+            # signals propagate — threading's excepthook reports them
+            for r in (self._inflight_batch or []):
+                if not r.done:
+                    self._observe("requests", "error", 1)
+                    r.fail(BatcherCrashed(
+                        f"batcher thread died mid-batch ({e!r}); "
+                        f"the supervisor restarts it — retry"))
+            self._inflight_batch = None
+            if self.on_crash is not None and isinstance(e, Exception):
+                self.on_crash(e)
+            else:
+                raise

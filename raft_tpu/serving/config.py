@@ -102,6 +102,27 @@ class ServeConfig:
     # Sessions idle longer than this are reaped outright (record included);
     # advancing a reaped id is a 404 — the client reopens.
     session_ttl_s: float = 300.0
+    # Chaos harness (serving/faults.py): a fault-injection spec like
+    # "seed=11,engine_error=0.05,nan=0.03,kill=0.01" arms the injector
+    # (--chaos / RAFT_TPU_CHAOS).  None (default) = off, zero overhead.
+    chaos: Optional[str] = None
+    # Circuit breaker (serving/breaker.py): when the device-call error
+    # rate over the last `breaker_window` calls reaches
+    # `breaker_threshold` (with at least `breaker_min_volume` observed),
+    # the breaker opens for `breaker_cooldown_s`: requests shed with 503
+    # + Retry-After and streaming sessions demote to the cold-restart
+    # path; then half-open probes decide recovery.  window 0 disables.
+    breaker_window: int = 64
+    breaker_threshold: float = 0.5
+    breaker_min_volume: int = 8
+    breaker_cooldown_s: float = 5.0
+    # Engine-failure containment (batcher): same-group retries (with
+    # backoff) before poisoned-batch bisection splits the blame.
+    engine_retries: int = 1
+    retry_backoff_ms: float = 20.0
+    # healthz reports "degraded" for this long after a batcher crash
+    # (and while the breaker is not closed) — the replica-gating signal.
+    degraded_window_s: float = 30.0
 
     def __post_init__(self):
         if self.batch_steps is None:
@@ -127,6 +148,24 @@ class ServeConfig:
         if not self.session_ttl_s > 0:
             raise ValueError(f"session_ttl_s must be > 0, "
                              f"got {self.session_ttl_s}")
+        if self.chaos:
+            from .faults import parse_chaos_spec
+            parse_chaos_spec(self.chaos)    # typo -> raise, up front
+        if self.breaker_window < 0:
+            raise ValueError(f"breaker_window must be >= 0 (0 disables "
+                             f"the breaker), got {self.breaker_window}")
+        if self.breaker_window and not 0.0 < self.breaker_threshold <= 1.0:
+            raise ValueError(f"breaker_threshold must be in (0, 1], "
+                             f"got {self.breaker_threshold}")
+        if self.breaker_window and not self.breaker_cooldown_s > 0:
+            raise ValueError(f"breaker_cooldown_s must be > 0, "
+                             f"got {self.breaker_cooldown_s}")
+        if self.engine_retries < 0:
+            raise ValueError(f"engine_retries must be >= 0, "
+                             f"got {self.engine_retries}")
+        if self.retry_backoff_ms < 0 or self.degraded_window_s < 0:
+            raise ValueError("retry_backoff_ms and degraded_window_s "
+                             "must be >= 0")
         steps = tuple(sorted(set(self.batch_steps)))
         if not steps or steps[0] < 1:
             raise ValueError(f"batch_steps must be positive, got {steps}")

@@ -43,8 +43,15 @@ class InferenceEngine:
     therefore the warm compile grid — never change with the data."""
 
     def __init__(self, config: RAFTConfig, params, sconfig: ServeConfig,
-                 iters: Optional[int] = None, stream: bool = False):
+                 iters: Optional[int] = None, stream: bool = False,
+                 faults=None):
         import jax
+
+        # chaos harness (serving/faults.py): injected engine exceptions,
+        # latency spikes, and NaN output rows enter HERE — the boundary
+        # the rest of the stack must contain.  None (the default) costs
+        # one attribute check per device call.
+        self.faults = faults
 
         if sconfig.iters_policy is not None:
             # the serving tier declares its compute policy up front, like
@@ -199,11 +206,19 @@ class InferenceEngine:
         n = im1.shape[0]
         ex = self._get_executable(self._key(h, w, n))
         self.pair_calls += 1
+        if self.faults is not None:
+            self.faults.pre_engine_call()
         out = ex(self.params, im1, im2)
         if self.adaptive:
             flow, iters_used = out
-            return np.asarray(flow), np.asarray(iters_used)
-        return np.asarray(out)
+            flow = np.asarray(flow)
+            if self.faults is not None:
+                flow = self.faults.corrupt_rows(flow)
+            return flow, np.asarray(iters_used)
+        flow = np.asarray(out)
+        if self.faults is not None:
+            flow = self.faults.corrupt_rows(flow)
+        return flow
 
     def run_encode(self, bucket: Tuple[int, int], image: np.ndarray):
         """[1, BH, BW, 3] float32 frame -> DEVICE-resident (fmap, cnet)
@@ -213,6 +228,8 @@ class InferenceEngine:
         h, w = bucket
         ex = self._get_executable(self._key(h, w, image.shape[0], "encode"))
         self.encode_calls += 1
+        if self.faults is not None:
+            self.faults.pre_engine_call()
         return ex(self.params, image)
 
     def run_stream(self, bucket: Tuple[int, int], image: np.ndarray,
@@ -225,6 +242,8 @@ class InferenceEngine:
         h, w = bucket
         ex = self._get_executable(self._key(h, w, image.shape[0], "stream"))
         self.stream_calls += 1
+        if self.faults is not None:
+            self.faults.pre_engine_call()
         out = ex(self.params, image, fmap_prev, cnet_prev, flow_init)
         if self.adaptive:
             flow, flow_lr, fmap, cnet, iters_used = out
@@ -232,5 +251,7 @@ class InferenceEngine:
         else:
             flow, flow_lr, fmap, cnet = out
             iters_used = None
-        return (np.asarray(flow), np.asarray(flow_lr), fmap, cnet,
-                iters_used)
+        flow = np.asarray(flow)
+        if self.faults is not None:
+            flow = self.faults.corrupt_rows(flow)
+        return flow, np.asarray(flow_lr), fmap, cnet, iters_used

@@ -31,8 +31,12 @@ string arrays.
 Error statuses: 400 malformed/unroutable input, 404 unknown path or
 unknown/expired stream session, 409 stream session busy (a frame already
 in flight), 413 body too large, 429 queue full (shed — retry with
-backoff), 503 draining, 504 deadline exceeded.  Every terminal status
-increments ``raft_serving_requests_total{status=...}``.
+backoff), 500 inference failure (including the ``poisoned`` class: a
+bisected-guilty or non-finite-output request), 503 draining or circuit
+breaker open, 504 deadline exceeded.  429 and 503 responses carry a
+``Retry-After`` header (seconds) — honor it; hammering a shedding server
+only deepens the storm.  Every terminal status increments
+``raft_serving_requests_total{status=...}``.
 """
 
 from __future__ import annotations
@@ -178,16 +182,29 @@ class _Handler(BaseHTTPRequestHandler):
         if app is not None and app.verbose:
             _log.info(f"{self.address_string()} {fmt % args}")
 
-    def _send(self, status: int, body: bytes, content_type: str) -> None:
+    def _send(self, status: int, body: bytes, content_type: str,
+              headers=None) -> None:
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_json(self, status: int, obj) -> None:
+    def _send_json(self, status: int, obj, headers=None) -> None:
         self._send(status, json.dumps(obj).encode(),
-                   "application/json")
+                   "application/json", headers=headers)
+
+    def _send_rejection(self, e) -> None:
+        """RejectedError -> its HTTP status; 429/503 advertise
+        ``Retry-After`` (whole seconds, >= 1) so clients back off
+        instead of retrying into the shed."""
+        headers = None
+        retry_after = getattr(e, "retry_after", None)
+        if retry_after is not None:
+            headers = {"Retry-After": str(max(1, int(-(-retry_after // 1))))}
+        self._send_json(e.http_status, {"error": str(e)}, headers=headers)
 
     # -- endpoints --------------------------------------------------------
 
@@ -196,17 +213,25 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?")[0]
         if path == "/healthz":
             if app.draining:
-                self._send_json(503, {"status": "draining"})
+                self._send_json(503, {"status": "draining"},
+                                headers={"Retry-After": "5"})
             else:
                 health = {
-                    "status": "ok",
+                    "status": app.health_status(),
                     "buckets": [list(b) for b in app.sconfig.buckets],
                     "batch_steps": list(app.sconfig.batch_steps),
                     "iters_policy": getattr(app.engine, "iters_policy",
                                             "fixed"),
                     "queue_depth": len(app.queue),
                     "executables": app.engine_executables(),
+                    "batcher": {
+                        "alive": app.batcher.alive,
+                        "restarts": app.supervisor.restarts,
+                    },
                 }
+                if app.breaker is not None:
+                    health["breaker"] = {"state": app.breaker.state,
+                                         "opens": app.breaker.opens}
                 streams = getattr(app, "streams", None)
                 if streams is not None:
                     health["stream"] = {
@@ -259,9 +284,9 @@ class _Handler(BaseHTTPRequestHandler):
             req = app.infer(im1, im2, deadline_ms)
         except RejectedError as e:
             # rejected/timeout accounting happens where the decision is
-            # made (submit / batcher purge / wait timeout); just translate
-            # to HTTP here
-            self._send_json(e.http_status, {"error": str(e)})
+            # made (submit / batcher purge / wait timeout / breaker);
+            # just translate to HTTP (+ Retry-After) here
+            self._send_rejection(e)
             return
         except BadRequest as e:
             app.count_request("bad_request")
@@ -303,8 +328,8 @@ class _Handler(BaseHTTPRequestHandler):
             res = app.stream_call(op, sid, image, deadline_ms)
         except RejectedError as e:
             # includes UnknownSession (404) and SessionBusy (409) — the
-            # status rides on the exception like every rejection
-            self._send_json(e.http_status, {"error": str(e)})
+            # status (and any Retry-After) rides on the exception
+            self._send_rejection(e)
             return
         except BadRequest as e:
             app.count_request("bad_request")

@@ -117,8 +117,56 @@ def make_stream_metrics(registry: Registry, store) -> Dict[str, _Metric]:
             "raft_stream_evictions_total",
             "Session evictions by reason: lru (features demoted past "
             "--max-sessions), ttl (idle record reaped), capacity "
-            "(record evicted outright)",
+            "(record evicted outright), degraded (breaker open / faulted "
+            "step: features dropped, next advance cold-restarts)",
             labelnames=("reason",)),
+        "degraded": registry.counter(
+            "raft_stream_degraded_total",
+            "Stream advances whose warm step faulted (engine error or "
+            "non-finite output) and were transparently retried through "
+            "the cold-restart path"),
     }
     store.evictions = m["evictions"]
     return m
+
+
+def make_robustness_metrics(registry: Registry,
+                            breaker=None) -> Dict[str, _Metric]:
+    """The self-healing metric families (failure containment, ISSUE 11):
+    always registered — they are production health signals, not debug
+    toggles.  The breaker's transition counter is handed back to it (the
+    decision-site labeling pattern the session store uses)."""
+    m = {
+        "nonfinite": registry.counter(
+            "raft_nonfinite_outputs_total",
+            "Flow output rows rejected by the non-finite sentinel "
+            "(each fails only its own request with a 500)"),
+        "batcher_restarts": registry.counter(
+            "raft_batcher_restarts_total",
+            "Batcher-thread crashes recovered by the supervisor "
+            "(healthz reports degraded while recent)"),
+    }
+    if breaker is not None:
+        registry.gauge(
+            "raft_breaker_state",
+            "Circuit breaker state: 0 closed, 1 half-open, 2 open "
+            "(open sheds with 503 + Retry-After)",
+            fn=breaker.state_code)
+        m["breaker_transitions"] = registry.counter(
+            "raft_breaker_transitions_total",
+            "Breaker state transitions by destination",
+            labelnames=("to",))
+        breaker.transitions = m["breaker_transitions"]
+    return m
+
+
+def make_fault_metrics(registry: Registry) -> Dict[str, _Metric]:
+    """Registered only when --chaos/RAFT_TPU_CHAOS arms the injector, so
+    an un-drilled server's /metrics exposition carries no chaos families."""
+    return {
+        "faults": registry.counter(
+            "raft_fault_injected_total",
+            "Faults injected by the chaos harness, by arm "
+            "(serving/faults.py; absent unless chaos is armed)",
+            labelnames=("arm",)),
+    }

@@ -23,18 +23,24 @@ import numpy as np
 
 
 class RejectedError(Exception):
-    """Base: request refused before reaching the device."""
+    """Base: request refused before reaching the device.  ``retry_after``
+    (seconds, None = don't advertise) rides to the HTTP layer as a
+    ``Retry-After`` header on 429/503 responses — the docstrings always
+    promised "retry with backoff"; now the wire says when."""
     http_status = 500
+    retry_after: Optional[float] = None
 
 
 class QueueFull(RejectedError):
     """Admission queue at capacity — shed, try again later (429)."""
     http_status = 429
+    retry_after = 1.0
 
 
 class Draining(RejectedError):
     """Server is shutting down; no new work accepted (503)."""
     http_status = 503
+    retry_after = 5.0
 
 
 class DeadlineExceeded(RejectedError):
@@ -73,6 +79,11 @@ class Request:
         # GRU iterations this request's sample actually spent (set by the
         # batcher under --iters-policy converge:*; None under 'fixed')
         self.iters_used: Optional[int] = None
+
+    @property
+    def done(self) -> bool:
+        """Resolved or failed (the supervisor's in-flight check)."""
+        return self._done.is_set()
 
     def resolve(self, flow: np.ndarray) -> None:
         self.result = flow

@@ -31,15 +31,83 @@ from ..telemetry import watchdogs as tlm_watchdogs
 from ..telemetry.log import get_logger
 from ..telemetry.trace import TraceWindow, stage
 from .batcher import MicroBatcher
+from .breaker import BreakerOpen, CircuitBreaker
 from .config import ServeConfig
 from .engine import InferenceEngine
+from .faults import make_injector
 from .http import BadRequest, make_http_server, serve_in_thread
-from .metrics import Registry, make_serving_metrics, make_stream_metrics
+from .metrics import (Registry, make_fault_metrics, make_robustness_metrics,
+                      make_serving_metrics, make_stream_metrics)
 from .queue import DeadlineExceeded, Draining, Request, RequestQueue
 from .session import SessionStore
 from .stream import StreamCoordinator
 
 _log = get_logger("serve")
+
+
+class BatcherSupervisor:
+    """Restart-on-crash policy for the batcher daemon (the device-owning
+    thread).  Before this, one stray exception escaping the loop killed
+    the thread silently and every later request hung into its 504 margin;
+    now a crash fails the in-flight batch (batcher._thread_main), lands
+    here, is counted (``raft_batcher_restarts_total``), and the loop is
+    restarted under exponential backoff.  ``/healthz`` reports
+    ``degraded`` while a crash is recent (``degraded_window_s``) or the
+    thread is down — the health signal ROADMAP item 3's replica gating
+    needs.  Consecutive-crash backoff resets once the thread has stayed
+    up a full degraded window."""
+
+    def __init__(self, server: "FlowServer", counter=None,
+                 degraded_window_s: float = 30.0,
+                 max_backoff_s: float = 2.0):
+        self.server = server
+        self.counter = counter            # raft_batcher_restarts_total
+        self.degraded_window_s = degraded_window_s
+        self.max_backoff_s = max_backoff_s
+        self.restarts = 0
+        self.last_crash: Optional[float] = None
+        self._consecutive = 0
+
+    def on_crash(self, exc: Exception) -> None:
+        """Runs on the dying batcher thread (batcher._thread_main)."""
+        now = time.monotonic()
+        if (self.last_crash is not None
+                and now - self.last_crash > self.degraded_window_s):
+            self._consecutive = 0         # stable period: backoff resets
+        self.last_crash = now
+        self.restarts += 1
+        if self.counter is not None:
+            self.counter.inc()
+        _log.error(f"batcher thread crashed ({exc!r}); restart "
+                   f"#{self.restarts}")
+        if self.server.draining:
+            self._fail_drained(exc)       # shutting down: no restart, but
+            return                        # queued work must not hang
+        backoff = min(0.05 * (2 ** self._consecutive), self.max_backoff_s)
+        self._consecutive += 1
+        time.sleep(backoff)
+        if self.server.draining:
+            self._fail_drained(exc)
+            return
+        self.server.batcher.restart()
+
+    def _fail_drained(self, exc: Exception) -> None:
+        """A crash during drain leaves the closed queue with no consumer:
+        fast-fail the remainder (the drain promise is 'completes or
+        errors', never 'hangs into the 504 margin')."""
+        from .batcher import BatcherCrashed
+        for r in self.server.queue.drain_remaining():
+            self.server.count_request("error")
+            r.fail(BatcherCrashed(
+                f"batcher crashed during drain ({exc!r}); request "
+                f"not executed"))
+
+    @property
+    def degraded(self) -> bool:
+        if self.last_crash is not None and (
+                time.monotonic() - self.last_crash < self.degraded_window_s):
+            return True
+        return not (self.server.batcher.alive or self.server.draining)
 
 
 class FlowServer:
@@ -62,6 +130,29 @@ class FlowServer:
         self.registry.gauge("raft_serving_queue_limit",
                             "Admission queue capacity (backpressure bound)"
                             ).set(sconfig.queue_depth)
+        # chaos harness: the injector exists only when --chaos/
+        # RAFT_TPU_CHAOS arms it — a clean server carries faults=None and
+        # pays one `is not None` per hook site
+        self.faults = None
+        if sconfig.chaos:
+            self.faults = make_injector(
+                sconfig.chaos,
+                counter=make_fault_metrics(self.registry)["faults"],
+                run_log=tlm_events.current())
+        # circuit breaker: sheds 503 + Retry-After while the engine is
+        # sick, demotes streaming sessions to the cold-restart path on
+        # open (breaker_window=0 disables)
+        self.breaker = None
+        if sconfig.breaker_window > 0:
+            self.breaker = CircuitBreaker(
+                window=sconfig.breaker_window,
+                threshold=sconfig.breaker_threshold,
+                min_volume=sconfig.breaker_min_volume,
+                cooldown_s=sconfig.breaker_cooldown_s,
+                on_open=self._breaker_opened)
+        self._robustness = make_robustness_metrics(self.registry,
+                                                   breaker=self.breaker)
+        self.metrics["nonfinite"] = self._robustness["nonfinite"]
         # streaming (/v1/stream): a bounded session store + coordinator,
         # built only when declared (--max-sessions > 0) so a pairwise-only
         # server keeps its exact warmup grid and /metrics exposition
@@ -71,15 +162,24 @@ class FlowServer:
             self.streams = StreamCoordinator(
                 store, sconfig, self.queue,
                 make_stream_metrics(self.registry, store),
-                self.count_request)
+                self.count_request, faults=self.faults,
+                nonfinite=self._robustness["nonfinite"],
+                breaker=self.breaker)
         # engine injection: tests drive the batching policy with stubs
         self.engine = engine if engine is not None else InferenceEngine(
             config, params, sconfig, iters=iters,
-            stream=sconfig.max_sessions > 0)
+            stream=sconfig.max_sessions > 0, faults=self.faults)
         self.batcher = MicroBatcher(
             self.queue, self._run_engine, sconfig.pad_batch_to,
             sconfig.max_batch, sconfig.max_wait_ms, metrics=self.metrics,
-            stream_fn=self._run_stream if self.streams else None)
+            stream_fn=self._run_stream if self.streams else None,
+            breaker=self.breaker, faults=self.faults,
+            retries=sconfig.engine_retries,
+            retry_backoff_s=sconfig.retry_backoff_ms / 1000.0,
+            on_crash=self._batcher_crashed)
+        self.supervisor = BatcherSupervisor(
+            self, counter=self._robustness["batcher_restarts"],
+            degraded_window_s=sconfig.degraded_window_s)
         self._httpd = None
         self._http_thread = None
         self._draining = threading.Event()
@@ -124,6 +224,43 @@ class FlowServer:
 
     def count_request(self, status: str) -> None:
         self.metrics["requests"].labels(status).inc()
+
+    # -- self-healing hooks ------------------------------------------------
+
+    def _batcher_crashed(self, exc: Exception) -> None:
+        self.supervisor.on_crash(exc)
+
+    def _breaker_opened(self) -> None:
+        """Breaker open: demote every streaming session's device features
+        so nothing cached before the storm is trusted after it — their
+        next advance takes the transparent cold-restart path."""
+        if self.streams is not None:
+            n = self.streams.store.demote_all()
+            if n:
+                _log.warning(f"breaker open: demoted {n} streaming "
+                             f"session(s) to the cold-restart path")
+
+    def _admit(self) -> None:
+        """Breaker gate shared by /v1/flow and /v1/stream admission."""
+        if self.breaker is None:
+            return
+        retry = self.breaker.allow()
+        if retry is not None:
+            self.count_request("breaker_open")
+            raise BreakerOpen(
+                f"circuit breaker open (device-call error rate over the "
+                f"last {self.sconfig.breaker_window} calls reached "
+                f"{self.sconfig.breaker_threshold:.0%}); retry in "
+                f"{retry:.1f}s", retry_after=retry)
+
+    def health_status(self) -> str:
+        """'ok' | 'degraded' — degraded while the batcher recently
+        crashed (or is down) or the breaker is not closed."""
+        if self.supervisor.degraded:
+            return "degraded"
+        if self.breaker is not None and self.breaker.state != "closed":
+            return "degraded"
+        return "ok"
 
     # -- lifecycle --------------------------------------------------------
 
@@ -203,6 +340,7 @@ class FlowServer:
         if self.draining:
             self.count_request("draining")
             raise Draining("server is draining; not accepting requests")
+        self._admit()                     # breaker gate: shed 503 while open
         h, w = im1.shape[0], im1.shape[1]
         bucket = self.sconfig.route(h, w)
         if bucket is None:
@@ -246,15 +384,18 @@ class FlowServer:
         if self.draining:
             self.count_request("draining")
             raise Draining("server is draining; not accepting requests")
+        if op == "close":
+            # closing is bookkeeping, never a device call: always allowed
+            return self.streams.close(session_id)
+        self._admit()                     # breaker gate: shed 503 while open
         if op == "open":
             return self.streams.open(image, deadline_ms)
-        if op == "close":
-            return self.streams.close(session_id)
         return self.streams.advance(session_id, image, deadline_ms)
 
 
 def serve_cli(args, config: RAFTConfig, load_params) -> int:
     """-m serve: build, warm, serve until SIGINT/SIGTERM, drain, exit 0."""
+    import os
     import signal
 
     from .config import parse_buckets
@@ -274,7 +415,19 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
             # silently turn an (invalid) explicit 0 into the default
             # instead of letting ServeConfig raise on it
             max_sessions=getattr(args, "max_sessions", 64),
-            session_ttl_s=getattr(args, "session_ttl_s", 300.0))
+            session_ttl_s=getattr(args, "session_ttl_s", 300.0),
+            # chaos drills: the CLI flag wins, the env var arms CI/ops.
+            # breaker knobs use None-checks, not `or`: --breaker-window 0
+            # is the documented breaker-off switch and must survive
+            chaos=(getattr(args, "chaos", None)
+                   or os.environ.get("RAFT_TPU_CHAOS") or None),
+            **{k: v for k, v in {
+                "breaker_window": getattr(args, "breaker_window", None),
+                "breaker_threshold": getattr(args, "breaker_threshold",
+                                             None),
+                "breaker_cooldown_s": getattr(args, "breaker_cooldown_s",
+                                              None),
+            }.items() if v is not None})
     except ValueError as e:
         print(f"ERROR: {e}")
         return 2
@@ -297,6 +450,9 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
         print(f"[serve] streaming: max_sessions={sconfig.max_sessions}  "
               f"session_ttl={sconfig.session_ttl_s:.0f}s  "
               f"POST {server.url}/v1/stream")
+    if server.faults is not None:
+        print(f"[serve] CHAOS ARMED: {sconfig.chaos} "
+              f"(fault injection live — drills only)")
     print(f"[serve] POST {server.url}/v1/flow   "
           f"GET {server.url}/healthz   GET {server.url}/metrics")
 

@@ -169,6 +169,23 @@ class SessionStore:
                 self._evict("lru")
                 excess -= 1
 
+    def demote_all(self, reason: str = "degraded") -> int:
+        """Drop EVERY session's device features (records kept): the
+        circuit breaker's degrade hook.  When the breaker opens the
+        engine is sick — cached per-session device state from before the
+        storm is not worth trusting, and dropping it routes every
+        surviving session through the transparent cold-restart path once
+        the breaker closes (correct flow, pairwise cost, no error).
+        In-flight sessions are skipped, same as LRU demotion."""
+        n = 0
+        with self._lock:
+            for s in self._sessions.values():
+                if s.has_features and not s.lock.locked():
+                    s.drop_features()
+                    self._evict(reason)
+                    n += 1
+        return n
+
     def _pop_lru_locked(self) -> Optional[Session]:
         for sid, s in self._sessions.items():
             if not s.lock.locked():

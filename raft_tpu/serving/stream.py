@@ -28,6 +28,7 @@ import numpy as np
 
 from ..data.pipeline import pad_to_shape
 from ..ops.warmstart import warm_start_seed
+from .batcher import NonFiniteOutput
 from .queue import (DeadlineExceeded, Draining, RejectedError, Request,
                     RequestQueue)
 from .session import Session, SessionStore
@@ -76,12 +77,16 @@ class StreamCoordinator:
     """
 
     def __init__(self, store: SessionStore, sconfig, queue: RequestQueue,
-                 metrics: Dict, count_fn):
+                 metrics: Dict, count_fn, faults=None, nonfinite=None,
+                 breaker=None):
         self.store = store
         self.sconfig = sconfig
         self.queue = queue
         self.metrics = metrics           # make_stream_metrics families
         self.count = count_fn            # FlowServer.count_request
+        self.faults = faults             # chaos injector (session arm)
+        self.nonfinite = nonfinite       # raft_nonfinite_outputs_total
+        self.breaker = breaker           # CircuitBreaker or None
 
     # -- handler-thread API ------------------------------------------------
 
@@ -183,32 +188,74 @@ class StreamCoordinator:
     def execute(self, req: StreamRequest, engine):
         """Run one stream step on the device.  Returns (padded flow or
         None, iters_used or None); all session/cache mutation happens
-        here, on the single thread that owns the device."""
+        here, on the single thread that owns the device.
+
+        Degradation ladder (SERVING.md): a *warm* step that faults —
+        engine exception or a non-finite flow output (e.g. poisoned
+        cached maps) — drops the session's device features and retries
+        once through the SAME transparent cold-restart path an evicted
+        session already takes: two encoder passes, correct flow, no
+        error.  A cold step that faults is terminal for this frame (the
+        client retries; session state was not advanced)."""
         s = req.session
-        H, W = s.bucket
         if req.stream_op == "open":
             fmap, cnet = engine.run_encode(s.bucket, req.image1)
             self.store.attach_features(s, fmap, cnet, None)
             s.last_image = req.image1
             return None, None
+        if self.faults is not None:
+            self.faults.corrupt_session(s)   # chaos: session-map arm
         warm = s.has_features
+        try:
+            flow, iters_used = self._advance_once(s, req, engine, warm)
+        except Exception:
+            # the failed warm call still counts against the breaker even
+            # though the advance will heal: it measures engine-call
+            # health, and a 100%-warm-failure mode must be visible (the
+            # batcher records only the advance's terminal outcome)
+            if self.breaker is not None:
+                self.breaker.record(False)
+            if not warm:
+                raise
+            s.drop_features()
+            self.store._evict("degraded")
+            self.metrics["degraded"].inc()
+            flow, iters_used = self._advance_once(s, req, engine,
+                                                  warm=False)
+            warm = False
+        s.frames += 1
+        req.warm = warm
+        req.frame = s.frames
+        self.metrics["frames"].inc()
+        return flow, iters_used
+
+    def _advance_once(self, s: Session, req: StreamRequest, engine,
+                      warm: bool):
+        """One advance attempt.  Session state (maps, last_image) is
+        mutated only AFTER the output passes the non-finite sentinel, so
+        a faulted attempt leaves the session exactly where it was."""
+        H, W = s.bucket
         if warm:
             # ONE encoder pass this step: frame t's maps are resident
             fmap_p, cnet_p = s.fmap, s.cnet
             init = warm_start_seed(s.prev_flow_lr, (H // 8, W // 8))
             self.metrics["fnet_hits"].inc()
         else:
-            # demoted (evicted features): cold two-encoder restart from
-            # the retained previous frame — pairwise cost, correct flow
+            # demoted/degraded: cold two-encoder restart from the
+            # retained previous frame — pairwise cost, correct flow
             fmap_p, cnet_p = engine.run_encode(s.bucket, s.last_image)
             init = np.zeros((1, H // 8, W // 8, 2), np.float32)
             self.metrics["fnet_misses"].inc()
         flow, flow_lr, fmap_c, cnet_c, iters_used = engine.run_stream(
             s.bucket, req.image1, fmap_p, cnet_p, init)
+        if not (np.isfinite(flow).all() and np.isfinite(flow_lr).all()):
+            # non-finite OUTPUT sentinel (inputs were validated at the
+            # HTTP edge): never cache poisoned maps or a poisoned seed
+            if self.nonfinite is not None:
+                self.nonfinite.inc()
+            raise NonFiniteOutput(
+                f"non-finite stream output for session {s.id} on a "
+                f"{'warm' if warm else 'cold'} step")
         self.store.attach_features(s, fmap_c, cnet_c, flow_lr)
         s.last_image = req.image1
-        s.frames += 1
-        req.warm = warm
-        req.frame = s.frames
-        self.metrics["frames"].inc()
         return flow, iters_used

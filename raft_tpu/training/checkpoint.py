@@ -134,14 +134,80 @@ def restore_checkpoint_compat(path, template):
             opt_state=opt._replace(inner_state=restored.opt_state))
 
 
-def latest_checkpoint(ckpt_dir) -> Optional[Path]:
-    """Newest step-numbered checkpoint in a directory (ckpt_<step>.npz)."""
+def list_checkpoints(ckpt_dir) -> list:
+    """Step-numbered checkpoints (ckpt_<step>.npz) in a directory,
+    sorted oldest-first as [(step, Path), ...]."""
     ckpt_dir = Path(ckpt_dir)
     if not ckpt_dir.is_dir():
-        return None
-    best, best_step = None, -1
+        return []
+    out = []
     for p in ckpt_dir.glob("ckpt_*.npz"):
         m = re.fullmatch(r"ckpt_(\d+)\.npz", p.name)
-        if m and int(m.group(1)) > best_step:
-            best, best_step = p, int(m.group(1))
-    return best
+        if m:
+            out.append((int(m.group(1)), p))
+    return sorted(out)
+
+
+def latest_checkpoint(ckpt_dir) -> Optional[Path]:
+    """Newest step-numbered checkpoint in a directory (ckpt_<step>.npz)."""
+    ckpts = list_checkpoints(ckpt_dir)
+    return ckpts[-1][1] if ckpts else None
+
+
+def prune_checkpoints(ckpt_dir, keep: int, log_fn=None) -> list:
+    """Retention: delete the oldest step-numbered checkpoints beyond the
+    newest ``keep``.  Call this only AFTER a successful atomic save
+    (save_checkpoint's write-then-rename), so retention can never reduce
+    the set of good checkpoints below what existed before the save.
+    Only ``ckpt_<step>.npz`` names are touched — exported weights,
+    metrics.jsonl etc. are never retention candidates.  Returns the
+    deleted paths."""
+    if keep < 1:
+        raise ValueError(f"keep_checkpoints must be >= 1, got {keep}")
+    doomed = list_checkpoints(ckpt_dir)[:-keep]
+    removed = []
+    for step, p in doomed:
+        try:
+            p.unlink()
+        except OSError:
+            continue               # raced/readonly: retention is advisory
+        removed.append(p)
+        if log_fn is not None:
+            log_fn(f"[train] pruned {p} (keeping newest {keep})")
+    return removed
+
+
+def checkpoint_readable(path) -> bool:
+    """True when every array in the npz decompresses cleanly — the
+    corruption probe behind restore_latest_with_fallback (a torn copy,
+    a bad disk, or a truncated transfer; the atomic save itself never
+    leaves these, but files travel)."""
+    try:
+        with np.load(path) as data:
+            for name in data.files:
+                data[name]         # forces decompression + CRC per member
+        return True
+    except Exception:  # noqa: BLE001 — any load failure means unreadable
+        return False
+
+
+def restore_latest_with_fallback(ckpt_dir, template, log_fn=print):
+    """Resume survivability: restore the newest *readable* checkpoint,
+    skipping corrupt/truncated files with a clear warning instead of
+    crashing the resume.  Returns (state, path) or (None, None) when no
+    readable checkpoint exists.  A checkpoint that reads fine but does
+    not match the template still raises — that is a config divergence,
+    not corruption, and silently skipping it would train the wrong run."""
+    # probe-then-restore reads the newest file twice on the happy path —
+    # a deliberate trade: one extra decompress per process start, in
+    # exchange for never misclassifying a template mismatch (a ValueError
+    # a single-pass design would have to disambiguate from decode errors)
+    # as corruption and silently resuming an older step
+    for step, p in reversed(list_checkpoints(ckpt_dir)):
+        if not checkpoint_readable(p):
+            log_fn(f"[train] WARNING: checkpoint {p} is corrupt or "
+                   f"truncated; skipping it and falling back to the "
+                   f"previous one")
+            continue
+        return restore_checkpoint_compat(p, template), p
+    return None, None

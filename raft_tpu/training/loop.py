@@ -23,7 +23,7 @@ from ..telemetry import Registry, config_hash, run_manifest
 from ..telemetry import events as tlm_events
 from ..telemetry import watchdogs as tlm_watchdogs
 from ..telemetry.trace import TraceWindow, stage
-from .checkpoint import (latest_checkpoint, restore_checkpoint_compat,
+from .checkpoint import (prune_checkpoints, restore_latest_with_fallback,
                          save_checkpoint)
 from .optim import make_optimizer
 from .state import TrainState
@@ -115,9 +115,12 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
 
     start_step = 0
     if ckpt_dir and resume:
-        latest = latest_checkpoint(ckpt_dir)
+        # fallback resume: a corrupt/truncated newest file (torn copy, bad
+        # disk) is skipped with a warning, the previous good one restores
+        restored, latest = restore_latest_with_fallback(ckpt_dir, state,
+                                                        log_fn=log_fn)
         if latest is not None:
-            state = restore_checkpoint_compat(latest, state)
+            state = restored
             start_step = int(state.step)
             log_fn(f"[train] resumed from {latest} at step {start_step}")
 
@@ -286,12 +289,20 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
             if _save_if_finite(Path(ckpt_dir) / f"ckpt_{step + 1}.npz",
                                state, log_fn):
                 m_ckpts.inc()
+                # retention prunes only AFTER the atomic save succeeded:
+                # a failed/skipped save never shrinks the good set
+                if tconfig.keep_checkpoints:
+                    prune_checkpoints(ckpt_dir, tconfig.keep_checkpoints,
+                                      log_fn=log_fn)
 
     trace_window.stop()
     if ckpt_dir and is_main:
         if _save_if_finite(Path(ckpt_dir) / f"ckpt_{int(state.step)}.npz",
                            state, log_fn, final=True):
             m_ckpts.inc()
+            if tconfig.keep_checkpoints:
+                prune_checkpoints(ckpt_dir, tconfig.keep_checkpoints,
+                                  log_fn=log_fn)
     if recompile_watch is not None:
         recompile_watch.remove()
         if recompile_watch.recompiles:
@@ -368,12 +379,13 @@ def train_cli(args, config: RAFTConfig) -> int:
         overrides["image_size"] = tuple(args.train_size)
     if getattr(args, "freeze_bn", None) is not None:
         overrides["freeze_bn"] = args.freeze_bn
-    for flag in ("ckpt_every", "log_every"):
+    for flag in ("ckpt_every", "log_every", "keep_checkpoints"):
         val = getattr(args, flag, None)
         if val is not None:
             if val < 1:
                 # validate before the slow compile: a zero period would
                 # ZeroDivisionError at the first `step % period` check
+                # (and keep-checkpoints 0 would delete every checkpoint)
                 print(f"ERROR: --{flag.replace('_', '-')} must be >= 1, "
                       f"got {val}")
                 return 2

@@ -1,0 +1,563 @@
+"""Chaos-armed robustness tests (tier-1, CPU): the fault-injection layer
+itself (determinism, spec parsing), and the self-healing ladder it exists
+to drill — supervisor restart on batcher death, poisoned-batch bisection,
+the non-finite output sentinel, circuit-breaker transitions, and the
+stream degrade-to-cold-restart path.
+
+Stub-engine tests are fully deterministic (forced injector outcomes, no
+timing races, no compiles); the two live-model tests share one tiny
+streaming server.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tpu.serving import (BatcherCrashed, BreakerOpen, ChaosSpec,
+                              CircuitBreaker, FaultInjected, FaultInjector,
+                              FlowServer, NonFiniteOutput, PoisonedRequest,
+                              Registry, RequestQueue, ServeConfig,
+                              SessionStore, make_injector, parse_chaos_spec)
+from raft_tpu.serving.batcher import MicroBatcher
+from raft_tpu.serving.metrics import make_serving_metrics
+
+from test_serving import BUCKET, StubEngine, make_request
+
+
+# ------------------------------------------------------------ faults.py --
+
+def test_parse_chaos_spec():
+    s = parse_chaos_spec("seed=7,engine_error=0.05,latency=0.1,"
+                         "latency_ms=150,nan=0.2,session=0.3,kill=1.0")
+    assert s == ChaosSpec(seed=7, engine_error=0.05, latency=0.1,
+                          latency_ms=150.0, nan=0.2, session=0.3, kill=1.0)
+    assert s.armed
+    assert parse_chaos_spec("") == ChaosSpec() and not ChaosSpec().armed
+    with pytest.raises(ValueError, match="unknown chaos arm"):
+        parse_chaos_spec("engine_eror=0.1")        # typo
+    with pytest.raises(ValueError, match="in \\[0, 1\\]"):
+        parse_chaos_spec("nan=1.5")
+    with pytest.raises(ValueError, match="key=value"):
+        parse_chaos_spec("nonsense")
+    # ServeConfig validates the spec up front, like every other knob
+    with pytest.raises(ValueError, match="unknown chaos arm"):
+        ServeConfig(chaos="bad_arm=0.5")
+
+
+def test_injector_deterministic_and_disarmable():
+    spec = parse_chaos_spec("seed=3,engine_error=0.5")
+    a, b = FaultInjector(spec), FaultInjector(spec)
+    rolls = [a.roll("engine_error") for _ in range(32)]
+    assert rolls == [b.roll("engine_error") for _ in range(32)]  # replays
+    assert any(rolls) and not all(rolls)
+    assert a.injected["engine_error"] == sum(rolls)
+    a.disarm()
+    assert not any(a.roll("engine_error") for _ in range(32))
+    a.rearm()
+    assert any(a.roll("engine_error") for _ in range(32))
+    # forced outcomes (tests' determinism hook) win over the rng
+    a.disarm()
+    a.force("kill", [1, 0, 1])
+    assert [a.roll("kill") for _ in range(4)] == [True, False, True, False]
+
+
+def test_injector_corrupt_rows_poisons_exactly_one_row():
+    inj = make_injector("seed=1")         # all-zero rates; forced only
+    flow = np.zeros((4, 8, 8, 2), np.float32)
+    assert inj.corrupt_rows(flow) is flow           # no fire: untouched
+    inj.force("nan", [1])
+    out = inj.corrupt_rows(flow)
+    assert np.isfinite(flow).all()                  # input copy-protected
+    bad = ~np.isfinite(out.reshape(4, -1)).all(axis=1)
+    assert bad.sum() == 1
+    assert inj.injected["nan"] == 1
+
+
+def test_injector_engine_error_and_latency_arms():
+    inj = make_injector("seed=1,latency_ms=30")
+    inj.force("latency", [1])
+    inj.force("engine_error", [0, 1])
+    t0 = time.monotonic()
+    inj.pre_engine_call()                           # latency fires: sleeps
+    assert time.monotonic() - t0 >= 0.025
+    with pytest.raises(FaultInjected):
+        inj.pre_engine_call()                       # error fires second
+
+
+# ----------------------------------------------------------- breaker.py --
+
+def test_breaker_state_machine():
+    clock = [0.0]
+    b = CircuitBreaker(window=8, threshold=0.5, min_volume=4,
+                       cooldown_s=10.0, clock=lambda: clock[0])
+    assert b.state == "closed" and b.allow() is None
+    for _ in range(3):
+        b.record(False)
+    assert b.state == "closed"          # below min_volume: no verdict yet
+    b.record(False)
+    assert b.state == "open" and b.opens == 1
+    retry = b.allow()
+    assert retry is not None and 0 < retry <= 10.0   # shed + Retry-After
+    b.record(True)                      # straggler while open: ignored
+    assert b.state == "open"
+    clock[0] = 10.5                     # cooldown elapsed -> half-open
+    assert b.allow() is None            # the probe slot
+    assert b.state == "half_open"
+    assert b.allow() is not None        # only one probe at a time
+    b.record(False)                     # probe failed -> re-open
+    assert b.state == "open" and b.opens == 2
+    clock[0] = 21.5
+    assert b.allow() is None
+    b.record(True)                      # probe succeeded -> closed
+    assert b.state == "closed" and b.allow() is None
+    # a healed window doesn't instantly re-open on one stray failure
+    b.record(False)
+    assert b.state == "closed"
+
+
+def test_breaker_lost_probe_replenishes():
+    """A granted half-open probe that dies before reaching the engine
+    (400/queue-full/deadline purge: no record() ever) must not wedge the
+    breaker — the slot replenishes after a cooldown."""
+    clock = [0.0]
+    b = CircuitBreaker(window=8, threshold=1.0, min_volume=2,
+                       cooldown_s=5.0, clock=lambda: clock[0])
+    b.record(False)
+    b.record(False)
+    clock[0] = 5.5
+    assert b.allow() is None            # the probe... which is then lost
+    assert b.allow() is not None        # slot taken: shed
+    clock[0] = 11.0                     # a cooldown after the lost probe
+    assert b.allow() is None            # replenished probe
+    b.record(True)
+    assert b.state == "closed"
+
+
+def test_breaker_window_zero_disables():
+    from test_serving import StubEngine as _SE
+    sconfig = ServeConfig(buckets=((32, 48),), max_batch=2,
+                          max_wait_ms=5.0, port=0, breaker_window=0)
+    server = FlowServer(None, None, sconfig, engine=_SE())
+    assert server.breaker is None       # --breaker-window 0: breaker off
+
+
+def test_breaker_open_demotes_stream_sessions():
+    store = SessionStore(max_sessions=4, ttl_s=60.0)
+    opened = []
+    b = CircuitBreaker(window=4, threshold=1.0, min_volume=2,
+                       cooldown_s=1.0,
+                       on_open=lambda: opened.append(store.demote_all()))
+    s1, s2 = store.open(BUCKET), store.open(BUCKET)
+    store.attach_features(s1, "f", "c", None)
+    store.attach_features(s2, "f", "c", None)
+    with s2.lock:                       # s2 mid-advance: not demotable
+        b.record(False)
+        b.record(False)
+    assert b.state == "open" and opened == [1]
+    assert not s1.has_features and s2.has_features
+
+
+# ------------------------------------- supervisor: batcher death drill ---
+
+def _stub_server(engine, chaos="seed=1", **cfg):
+    defaults = dict(buckets=((32, 48),), max_batch=4, batch_steps=(1, 2, 4),
+                    max_wait_ms=5.0, queue_depth=16, port=0, max_sessions=0,
+                    chaos=chaos, degraded_window_s=0.4,
+                    retry_backoff_ms=1.0, default_deadline_ms=10_000.0)
+    defaults.update(cfg)
+    sconfig = ServeConfig(**defaults)
+    server = FlowServer(None, None, sconfig, engine=engine)
+    server.start()
+    return server
+
+
+def _get_json(server, path):
+    with urllib.request.urlopen(server.url + path) as r:
+        return json.loads(r.read())
+
+
+def test_batcher_death_supervisor_restart_and_degraded_healthz():
+    """The drill the ISSUE names: kill the batcher thread mid-batch; the
+    in-flight request fails fast (no hang into its 504 margin), the
+    supervisor restarts the loop, /healthz reports degraded while the
+    crash is recent and returns to ok after the window, and the restart
+    is visible in raft_batcher_restarts_total."""
+    server = _stub_server(StubEngine())
+    try:
+        server.faults.force("kill", [1])
+        im = np.zeros((32, 48, 3), np.float32)
+        t0 = time.monotonic()
+        with pytest.raises(BatcherCrashed):
+            server.infer(im, im)
+        assert time.monotonic() - t0 < 5.0          # failed FAST, no hang
+        deadline = time.monotonic() + 5.0
+        while not server.batcher.alive and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert server.batcher.alive                 # supervisor restarted it
+        assert server.supervisor.restarts == 1
+        h = _get_json(server, "/healthz")
+        assert h["status"] == "degraded"            # crash is recent
+        assert h["batcher"]["restarts"] == 1
+        # the restarted loop serves normally
+        assert server.infer(im, im).result.shape == (32, 48, 2)
+        time.sleep(0.5)                             # degraded_window_s=0.4
+        assert _get_json(server, "/healthz")["status"] == "ok"
+        with urllib.request.urlopen(server.url + "/metrics") as r:
+            assert "raft_batcher_restarts_total 1" in r.read().decode()
+    finally:
+        server.stop()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_batcher_shutdown_signal_not_swallowed():
+    """The BaseException satellite: KeyboardInterrupt escaping the engine
+    fails the batch (no hung handler) but is NOT converted into a
+    restart — shutdown wins."""
+    class InterruptEngine(StubEngine):
+        def run(self, bucket, im1, im2):
+            raise KeyboardInterrupt
+
+    q = RequestQueue(8)
+    b = MicroBatcher(q, InterruptEngine().run, lambda n: n, 2, 5.0,
+                     on_crash=lambda e: pytest.fail("restarted on KI"))
+    b.start()
+    r = make_request(bucket=(32, 48))
+    q.submit(r)
+    with pytest.raises(KeyboardInterrupt):
+        r.wait(timeout=10)                          # failed, not hung
+    b.join(5)
+    assert not b.alive                              # thread really exited
+    q.close()
+
+
+# -------------------------------------- bisection + non-finite sentinel --
+
+class PoisonEngine(StubEngine):
+    """Fails (or emits NaN) whenever the marked request is in the batch:
+    the marker is a constant-1.0 image1, innocents are zeros."""
+
+    def __init__(self, mode="raise"):
+        super().__init__()
+        self.mode = mode
+
+    def run(self, bucket, im1, im2):
+        self.calls.append((bucket, im1.shape[0]))
+        poisoned = np.asarray([float(im1[i].max()) >= 1.0
+                               for i in range(im1.shape[0])])
+        flows = np.zeros(im1.shape[:3] + (2,), np.float32)
+        if poisoned.any():
+            if self.mode == "raise":
+                raise RuntimeError("device rejected the poisoned row")
+            flows[np.argmax(poisoned)] = np.inf
+        return flows
+
+
+def _poison_request():
+    h, w = BUCKET
+    im = np.ones((1, h, w, 3), np.float32)
+    from raft_tpu.serving import Request
+    return Request(im, im, BUCKET, (0, 0, 0, 0),
+                   deadline=time.monotonic() + 30.0)
+
+
+def _metrics_stack(eng, max_batch=4, retries=1):
+    q = RequestQueue(16)
+    reg = Registry()
+    sc = ServeConfig(buckets=(BUCKET,), max_batch=max_batch,
+                     batch_steps=(1, 2, 4), max_wait_ms=30.0)
+    metrics = make_serving_metrics(reg, sc)
+    from raft_tpu.serving.metrics import make_robustness_metrics
+    metrics["nonfinite"] = make_robustness_metrics(reg)["nonfinite"]
+    b = MicroBatcher(q, eng.run, sc.pad_batch_to, max_batch, 30.0,
+                     metrics=metrics, retries=retries,
+                     retry_backoff_s=0.001)
+    b.start()
+    return q, b, reg
+
+
+def test_bisection_isolates_exactly_the_poisoned_request():
+    """4 coalesced requests, one poisons every batch containing it: the
+    3 innocents resolve, the guilty one alone fails as PoisonedRequest,
+    and every bisection probe ran at a declared batch step (no new
+    shapes = no recompiles on a live engine)."""
+    eng = PoisonEngine(mode="raise")
+    q, b, reg = _metrics_stack(eng)
+    innocents = [make_request() for _ in range(3)]
+    guilty = _poison_request()
+    for r in (innocents[0], guilty, innocents[1], innocents[2]):
+        q.submit(r)
+    for r in innocents:
+        assert r.wait(timeout=10).shape == (32, 48, 2)   # unharmed
+    with pytest.raises(PoisonedRequest, match="poisons its batch"):
+        guilty.wait(timeout=10)
+    # every probe used a declared step (1, 2 or 4) — warm-grid shapes only
+    assert all(n in (1, 2, 4) for _, n in eng.calls)
+    assert reg.get("raft_serving_requests_total").labels("ok").value == 3
+    assert reg.get("raft_serving_requests_total").labels(
+        "poisoned").value == 1
+    q.close()
+    b.join(5)
+
+
+def test_transient_engine_error_healed_by_retry():
+    """One flaky failure then success: the retry path absorbs it — no
+    bisection, no failed requests."""
+    class FlakyEngine(StubEngine):
+        def __init__(self):
+            super().__init__()
+            self.failed_once = False
+
+        def run(self, bucket, im1, im2):
+            self.calls.append((bucket, im1.shape[0]))
+            if not self.failed_once:
+                self.failed_once = True
+                raise RuntimeError("transient device hiccup")
+            return np.zeros(im1.shape[:3] + (2,), np.float32)
+
+    eng = FlakyEngine()
+    q, b, _ = _metrics_stack(eng)
+    reqs = [make_request() for _ in range(4)]
+    for r in reqs:
+        q.submit(r)
+    for r in reqs:
+        assert r.wait(timeout=10).shape == (32, 48, 2)
+    assert [n for _, n in eng.calls] == [4, 4]      # same batch, retried
+    q.close()
+    b.join(5)
+
+
+def test_sick_engine_exhausts_budget_without_trapping_the_thread():
+    """Every call fails: the budget caps the retry storm, every request
+    fails (status=error — the engine is sick, nobody is 'poisoned'),
+    and the batcher survives to serve the next healthy batch."""
+    eng = StubEngine(fail=True)
+    q, b, reg = _metrics_stack(eng)
+    reqs = [make_request() for _ in range(4)]
+    for r in reqs:
+        q.submit(r)
+    for r in reqs:
+        with pytest.raises(RuntimeError):
+            r.wait(timeout=20)
+    assert len(eng.calls) <= (1 + 1) * 2 * 4        # the bisect budget
+    eng.fail = False
+    r2 = make_request()
+    q.submit(r2)
+    assert r2.wait(timeout=10).shape == (32, 48, 2)
+    q.close()
+    b.join(5)
+
+
+def test_nan_output_row_fails_alone_neighbors_succeed():
+    """The non-finite output sentinel: the engine succeeds but one row is
+    Inf — that request alone gets the poisoned 500 class, innocents
+    resolve, raft_nonfinite_outputs_total counts the row."""
+    eng = PoisonEngine(mode="nan")
+    q, b, reg = _metrics_stack(eng)
+    innocents = [make_request() for _ in range(3)]
+    guilty = _poison_request()
+    for r in (innocents[0], innocents[1], guilty, innocents[2]):
+        q.submit(r)
+    for r in innocents:
+        flow = r.wait(timeout=10)
+        assert np.isfinite(flow).all()
+    with pytest.raises(NonFiniteOutput, match="non-finite flow output"):
+        guilty.wait(timeout=10)
+    assert len(eng.calls) == 1                      # no bisection needed
+    assert reg.get("raft_nonfinite_outputs_total").value == 1
+    assert reg.get("raft_serving_requests_total").labels(
+        "poisoned").value == 1
+    q.close()
+    b.join(5)
+
+
+# ------------------------------------------------- breaker integration ---
+
+def test_breaker_opens_sheds_503_and_recovers():
+    """Persistent engine failure trips the breaker: later submissions are
+    shed with BreakerOpen/503 + Retry-After before touching the queue;
+    healthz reports degraded; after the cooldown a half-open probe on the
+    healed engine closes it again."""
+    eng = StubEngine(fail=True)
+    server = _stub_server(eng, breaker_window=8, breaker_threshold=0.5,
+                          breaker_min_volume=2, breaker_cooldown_s=0.3,
+                          engine_retries=0)
+    try:
+        im = np.zeros((32, 48, 3), np.float32)
+        for _ in range(2):                          # reach min_volume=2
+            with pytest.raises(RuntimeError):
+                server.infer(im, im)                # records the failures
+        assert server.breaker.state == "open"
+        with pytest.raises(BreakerOpen) as ei:
+            server.infer(im, im)
+        assert ei.value.http_status == 503
+        assert ei.value.retry_after is not None
+        assert _get_json(server, "/healthz")["breaker"]["state"] == "open"
+        # the wire contract: 503 + Retry-After header
+        req = urllib.request.Request(
+            server.url + "/v1/flow",
+            data=json.dumps({"image1": im.tolist(),
+                             "image2": im.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as he:
+            urllib.request.urlopen(req)
+        assert he.value.code == 503
+        assert int(he.value.headers["Retry-After"]) >= 1
+        # storm over: heal the engine, wait out the cooldown, probe
+        eng.fail = False
+        time.sleep(0.35)
+        assert server.infer(im, im).result.shape == (32, 48, 2)
+        assert server.breaker.state == "closed"
+        with urllib.request.urlopen(server.url + "/metrics") as r:
+            text = r.read().decode()
+        assert "raft_breaker_state 0" in text
+        assert 'raft_breaker_transitions_total{to="open"} 1' in text
+        assert 'raft_breaker_transitions_total{to="closed"} 1' in text
+    finally:
+        server.stop()
+
+
+def test_queue_full_429_advertises_retry_after():
+    gate = threading.Event()
+    eng = StubEngine(gate=gate)
+    server = _stub_server(eng, chaos=None, max_batch=1, batch_steps=(1,),
+                          queue_depth=1)
+    try:
+        im = np.zeros((32, 48, 3), np.float32)
+        results = []
+
+        def bg():
+            try:
+                results.append(server.infer(im, im))
+            except Exception as e:     # noqa: BLE001 — surfaced below
+                results.append(e)
+
+        t1 = threading.Thread(target=bg)            # occupies the engine
+        t1.start()
+        assert eng.entered.wait(10)
+        t2 = threading.Thread(target=bg)            # fills the queue
+        t2.start()
+        time.sleep(0.1)
+        body = json.dumps({"image1": im.tolist(),
+                           "image2": im.tolist()}).encode()
+        req = urllib.request.Request(
+            server.url + "/v1/flow", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as he:
+            urllib.request.urlopen(req)             # 3rd: shed
+        assert he.value.code == 429
+        assert int(he.value.headers["Retry-After"]) >= 1
+        gate.set()
+        t1.join(10)
+        t2.join(10)
+    finally:
+        gate.set()
+        server.stop()
+
+
+# ------------------------------------------- stream degrade (live model) --
+
+@pytest.fixture(scope="module")
+def chaos_stream_server():
+    """A tiny live streaming server with the injector built but every
+    rate at zero: tests force the exact faults they need."""
+    from raft_tpu.config import RAFTConfig, init_rng
+    from raft_tpu.models import init_raft
+
+    config = RAFTConfig.small_model(iters=2)
+    params = init_raft(init_rng(), config)
+    sconfig = ServeConfig(buckets=((32, 48),), max_batch=1,
+                          batch_steps=(1,), max_wait_ms=5.0,
+                          queue_depth=16, default_deadline_ms=30_000.0,
+                          port=0, max_sessions=2, session_ttl_s=600.0,
+                          chaos="seed=1", engine_retries=0)
+    server = FlowServer(config, params, sconfig)
+    server.start()
+    yield server
+    server.stop()
+
+
+def _post_stream(server, payload):
+    req = urllib.request.Request(
+        server.url + "/v1/stream", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def _post_flow(server, im1, im2):
+    req = urllib.request.Request(
+        server.url + "/v1/flow",
+        data=json.dumps({"image1": im1.tolist(),
+                         "image2": im2.tolist()}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_stream_engine_fault_degrades_to_cold_restart(chaos_stream_server):
+    """A warm advance whose stream step faults degrades transparently:
+    features dropped, the SAME advance re-runs cold, and the flow equals
+    the pairwise answer on the same frames — the client sees 200, not a
+    500 and a poisoned session."""
+    server = chaos_stream_server
+    rng = np.random.RandomState(50)
+    frames = [rng.rand(32, 48, 3).astype(np.float32) for _ in range(3)]
+    sid = _post_stream(server, {"image": frames[0].tolist()})["session"]
+    r1 = _post_stream(server, {"session": sid, "image": frames[1].tolist()})
+    assert r1["meta"]["warm"] is True
+    # the NEXT stream-step device call faults (injected engine error on
+    # the warm attempt); run_encode is untouched (empty forced queue ->
+    # zero rates), so the cold retry inside the same advance succeeds
+    server.faults.force("engine_error", [1])
+    r2 = _post_stream(server, {"session": sid, "image": frames[2].tolist()})
+    assert r2["meta"]["warm"] is False              # degraded to cold
+    pw = _post_flow(server, frames[1], frames[2])
+    np.testing.assert_allclose(np.asarray(r2["flow"], np.float32),
+                               np.asarray(pw["flow"], np.float32),
+                               rtol=1e-4, atol=1e-2)
+    with urllib.request.urlopen(server.url + "/metrics") as r:
+        text = r.read().decode()
+    assert "raft_stream_degraded_total 1" in text
+    assert 'raft_stream_evictions_total{reason="degraded"} 1' in text
+    assert 'raft_fault_injected_total{arm="engine_error"} 1' in text
+    assert server.engine.compile_misses == 0        # bisect/retry: warm grid
+    _post_stream(server, {"op": "close", "session": sid})
+
+
+def test_stream_session_corruption_caught_by_sentinel(chaos_stream_server):
+    """The session arm poisons the cached fmap with NaN device-side; the
+    NaNs propagate into the warm step's flow, the non-finite sentinel
+    rejects it, and the advance still answers correct (cold) flow."""
+    server = chaos_stream_server
+    rng = np.random.RandomState(51)
+    frames = [rng.rand(32, 48, 3).astype(np.float32) for _ in range(3)]
+    sid = _post_stream(server, {"image": frames[0].tolist()})["session"]
+    _post_stream(server, {"session": sid, "image": frames[1].tolist()})
+    nonfinite0 = server._robustness["nonfinite"].value
+    server.faults.force("session", [1])
+    r2 = _post_stream(server, {"session": sid, "image": frames[2].tolist()})
+    assert r2["meta"]["warm"] is False              # degraded to cold
+    assert np.isfinite(np.asarray(r2["flow"])).all()
+    pw = _post_flow(server, frames[1], frames[2])
+    np.testing.assert_allclose(np.asarray(r2["flow"], np.float32),
+                               np.asarray(pw["flow"], np.float32),
+                               rtol=1e-4, atol=1e-2)
+    assert server._robustness["nonfinite"].value == nonfinite0 + 1
+    _post_stream(server, {"op": "close", "session": sid})
+
+
+def test_session_store_demote_all_skips_inflight():
+    store = SessionStore(max_sessions=4, ttl_s=60.0)
+    a, b = store.open(BUCKET), store.open(BUCKET)
+    store.attach_features(a, "f", "c", None)
+    store.attach_features(b, "f", "c", None)
+    with b.lock:
+        assert store.demote_all() == 1
+    assert not a.has_features and b.has_features
+    assert store.resident_count() == 2              # records kept

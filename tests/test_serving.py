@@ -442,6 +442,8 @@ def test_live_healthz_and_metrics(live_server):
     assert h["status"] == "ok"
     assert h["buckets"] == [[32, 48], [64, 96]]
     assert h["executables"] == 2
+    assert h["batcher"]["alive"] is True and h["batcher"]["restarts"] == 0
+    assert h["breaker"]["state"] == "closed"
     with urllib.request.urlopen(server.url + "/metrics") as r:
         assert r.status == 200
         assert "text/plain" in r.headers["Content-Type"]
@@ -453,16 +455,22 @@ def test_live_healthz_and_metrics(live_server):
                  "raft_serving_request_latency_seconds_bucket",
                  "raft_serving_compile_cache_misses_total",
                  "raft_serving_compile_cache_entries",
-                 "raft_serving_queue_limit"):
+                 "raft_serving_queue_limit",
+                 "raft_nonfinite_outputs_total",
+                 "raft_batcher_restarts_total",
+                 "raft_breaker_state"):
         assert name in text, name
+    # chaos families absent on an un-drilled server
+    assert "raft_fault_injected_total" not in text
     assert 'raft_serving_requests_total{status="ok"}' in text
     assert "raft_serving_compile_cache_misses_total 0" in text
 
 
 def test_http_engine_failure_returns_500_not_dropped_socket():
-    """An engine exception must surface as HTTP 500 JSON (counted as
-    status=error where the batch died), not a reset connection; and the
-    queue-depth gauge is a live callback, not a stale snapshot."""
+    """A persistent engine exception must surface as HTTP 500 JSON — a
+    lone request is its own bisection terminus, so it is counted as
+    status=poisoned — not a reset connection; and the queue-depth gauge
+    is a live callback, not a stale snapshot."""
     eng = StubEngine(fail=True)
     sconfig = ServeConfig(buckets=((32, 48),), max_batch=2,
                           max_wait_ms=5.0, queue_depth=4, port=0)
@@ -480,7 +488,7 @@ def test_http_engine_failure_returns_500_not_dropped_socket():
         assert "engine exploded" in json.loads(ei.value.read())["error"]
         with urllib.request.urlopen(server.url + "/metrics") as r:
             text = r.read().decode()
-        assert 'raft_serving_requests_total{status="error"} 1' in text
+        assert 'raft_serving_requests_total{status="poisoned"} 1' in text
         assert "raft_serving_queue_depth 0" in text   # live callback gauge
     finally:
         server.stop()

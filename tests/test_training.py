@@ -963,3 +963,90 @@ def test_sintel_warm_start_eval(tmp_path, monkeypatch):
     with pytest.raises(ValueError, match="scene structure"):
         evaluate_dataset(params, config, _MixedResolutionDataset(),
                          warm_start=True, verbose=False)
+
+
+# ----------------------------------- checkpoint retention + fallback -----
+
+def _fake_ckpt(dirpath, step, value=0.0):
+    """A real (loadable) step-numbered checkpoint of a tiny pytree."""
+    from raft_tpu.training.checkpoint import save_checkpoint
+    p = dirpath / f"ckpt_{step}.npz"
+    save_checkpoint(p, {"w": np.full((3,), value, np.float32),
+                        "step": np.int64(step)})
+    return p
+
+
+def test_prune_checkpoints_keeps_newest_n(tmp_path):
+    from raft_tpu.training.checkpoint import (latest_checkpoint,
+                                              list_checkpoints,
+                                              prune_checkpoints)
+    for s in (100, 20, 300, 5):
+        _fake_ckpt(tmp_path, s)
+    (tmp_path / "weights_export.npz").write_bytes(b"not a ckpt")
+    removed = prune_checkpoints(tmp_path, keep=2)
+    assert sorted(p.name for p in removed) == ["ckpt_20.npz", "ckpt_5.npz"]
+    assert [s for s, _ in list_checkpoints(tmp_path)] == [100, 300]
+    assert latest_checkpoint(tmp_path).name == "ckpt_300.npz"
+    # non-checkpoint files are never retention candidates
+    assert (tmp_path / "weights_export.npz").exists()
+    # keep >= count: nothing removed; keep < 1 rejected
+    assert prune_checkpoints(tmp_path, keep=5) == []
+    with pytest.raises(ValueError):
+        prune_checkpoints(tmp_path, keep=0)
+
+
+def test_restore_latest_with_fallback_skips_corrupt_newest(tmp_path):
+    from raft_tpu.training.checkpoint import restore_latest_with_fallback
+    _fake_ckpt(tmp_path, 1, value=1.0)
+    good = _fake_ckpt(tmp_path, 2, value=2.0)
+    # newest is truncated mid-write-style (a torn copy / bad disk; the
+    # atomic save itself never leaves these, but files travel)
+    torn = _fake_ckpt(tmp_path, 3, value=3.0)
+    torn.write_bytes(torn.read_bytes()[:128])
+    template = {"w": np.zeros((3,), np.float32), "step": np.int64(0)}
+    warnings = []
+    state, path = restore_latest_with_fallback(tmp_path, template,
+                                               log_fn=warnings.append)
+    assert path == good
+    np.testing.assert_array_equal(state["w"], np.full((3,), 2.0))
+    assert any("corrupt" in w for w in warnings)
+    # every candidate corrupt -> (None, None), fresh start
+    for p in tmp_path.glob("ckpt_*.npz"):
+        p.write_bytes(b"garbage")
+    state, path = restore_latest_with_fallback(tmp_path, template,
+                                               log_fn=warnings.append)
+    assert state is None and path is None
+    # a READABLE checkpoint that mismatches the template still raises:
+    # config divergence is an error, not corruption
+    _fake_ckpt(tmp_path, 9)
+    with pytest.raises(ValueError, match="does not match"):
+        restore_latest_with_fallback(
+            tmp_path, {"other": np.zeros((2,), np.float32)},
+            log_fn=warnings.append)
+
+
+def test_keep_checkpoints_retention_in_training_loop(tmp_path):
+    """--keep-checkpoints end to end: a short synthetic train run with
+    ckpt_every=1, keep=2 must leave exactly the 2 newest checkpoints, and
+    resume-with-fallback must survive the newest being truncated."""
+    from raft_tpu.data.pipeline import synthetic_batches
+    from raft_tpu.training.checkpoint import list_checkpoints
+    from raft_tpu.training.loop import train
+
+    config = RAFTConfig.small_model(iters=2)
+    tconfig = TrainConfig(num_steps=4, lr=1e-4, schedule="constant",
+                          batch_size=2, ckpt_every=1, log_every=1,
+                          keep_checkpoints=2, image_size=(64, 96))
+    ckpt_dir = tmp_path / "ckpts"
+    train(config, tconfig, synthetic_batches(2, (64, 96)),
+          ckpt_dir=str(ckpt_dir), data_parallel=False, log_fn=lambda m: None)
+    assert [s for s, _ in list_checkpoints(ckpt_dir)] == [3, 4]
+    # corrupt the newest; resume falls back to step 3 with a warning
+    (ckpt_dir / "ckpt_4.npz").write_bytes(b"torn")
+    logs = []
+    tconfig6 = dataclasses.replace(tconfig, num_steps=6)
+    train(config, tconfig6, synthetic_batches(2, (64, 96)),
+          ckpt_dir=str(ckpt_dir), data_parallel=False, log_fn=logs.append)
+    assert any("corrupt" in m for m in logs)
+    assert any("resumed" in m and "ckpt_3" in m for m in logs)
+    assert [s for s, _ in list_checkpoints(ckpt_dir)] == [5, 6]

@@ -21,6 +21,17 @@ and appended to ``BENCH_serving.json`` (one JSON object per line).
 requests; exits nonzero if the batcher never coalesced (occupancy <= 1)
 or anything recompiled after warmup.
 
+``--chaos SPEC`` arms the fault injector (serving/faults.py) on the
+in-process server and turns the run into a **self-healing drill**: the
+storm phase drives normal load with engine exceptions / latency spikes /
+NaN rows / batcher kills firing at the spec's seeded rates, then the
+injector is disarmed and the recovery phase feeds clean probes until
+``/healthz`` returns to ``ok``.  With ``--smoke`` it asserts the
+acceptance criteria: every failure is attributable to an injected fault
+(bisection protected the innocents), nothing hung past its deadline, the
+supervisor's restarts are visible in ``raft_batcher_restarts_total``,
+healthz recovers within one breaker window, and nothing recompiled.
+
 ``--video`` switches to the streaming-workload probe: ``--sessions``
 synthetic N-frame sequences (``--frames``) each run twice over the SAME
 frames — pairwise through ``/v1/flow`` (the cold baseline: two encoder
@@ -218,6 +229,89 @@ def run_video(host, port, sequences, stream):
     for t in threads:
         t.join()
     return results, time.monotonic() - t0
+
+
+def run_chaos_recovery(args, host, port, server, results, body, deadline_s):
+    """The drill's second act: disarm the injector, feed clean probes
+    until /healthz reports ok (the supervisor's degraded window and the
+    breaker's cooldown both have to clear), and audit the storm phase.
+    Returns (record, problems) — problems gate --smoke."""
+    injected = dict(server.faults.injected)
+    server.faults.disarm()
+    # clean probes reuse the storm body: they feed the breaker's
+    # half-open probe slot and prove the engine answers again
+    probe = Client(host, port, body, [], threading.Lock())
+    t0 = time.monotonic()
+    timeout = max(server.sconfig.breaker_cooldown_s,
+                  server.sconfig.degraded_window_s) + 10.0
+    status, recovered_s = None, None
+    while time.monotonic() - t0 < timeout:
+        probe.one()
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.request("GET", "/healthz")
+            status = json.loads(conn.getresponse().read()).get("status")
+            conn.close()
+        except Exception:
+            status = None
+        if status == "ok":
+            recovered_s = time.monotonic() - t0
+            break
+        time.sleep(0.2)
+
+    statuses = {}
+    for st, _ in results:
+        statuses[str(st)] = statuses.get(str(st), 0) + 1
+    total = len(results)
+    ok = statuses.get("200", 0)
+    # breaker sheds (503) are the ladder WORKING, not unprotected
+    # failures — reported separately, excluded from the attribution bound
+    sheds = statuses.get("503", 0)
+    failures = total - ok - sheds
+    # every remaining failure must be attributable to an injected fault:
+    # a NaN row or a persistent engine error fails exactly the guilty
+    # request (bisection), a batcher kill fails at most its in-flight
+    # batch, a latency spike can push one request past its deadline (504)
+    bound = (injected["nan"] + injected["engine_error"]
+             + injected["kill"] * args.max_batch + injected["session"]
+             + injected["latency"])
+    max_lat = max((lat for _, lat in results), default=0.0)
+    restarts = server.supervisor.restarts
+    rec = {
+        "spec": args.chaos,
+        "injected": injected,
+        "statuses": statuses,
+        "failures": failures,
+        "breaker_sheds_503": sheds,
+        "attributable_bound": bound,
+        "max_latency_s": round(max_lat, 3),
+        "batcher_restarts": restarts,
+        "breaker_opens": server.breaker.opens if server.breaker else None,
+        "healthz_after_storm": status,
+        "recovered_s": round(recovered_s, 3) if recovered_s else None,
+    }
+    problems = []
+    if statuses.get("-1"):
+        problems.append(f"{statuses['-1']} dropped/errored connection(s) "
+                        f"under chaos")
+    if failures > bound:
+        problems.append(
+            f"{failures} failed request(s) but only {bound} attributable "
+            f"to injected faults — innocents were not protected "
+            f"(injected: {injected})")
+    if max_lat > deadline_s + 1.0:
+        problems.append(f"a request took {max_lat:.1f}s — past its "
+                        f"{deadline_s:.0f}s deadline (hung?)")
+    if injected["kill"] and restarts < 1:
+        problems.append(f"{injected['kill']} batcher kill(s) injected but "
+                        f"raft_batcher_restarts_total shows no restart")
+    if sum(injected.values()) == 0:
+        problems.append("chaos armed but no fault ever fired — the drill "
+                        "tested nothing (raise rates or requests)")
+    if status != "ok":
+        problems.append(f"healthz still {status!r} "
+                        f"{timeout:.0f}s after the storm")
+    return rec, problems
 
 
 def run_closed(host, port, body, clients, total):
@@ -447,13 +541,27 @@ def main() -> int:
                         "asserts coalescing and zero recompiles (with "
                         "--video: zero recompiles + non-zero fnet cache "
                         "hits on a 4-frame session drive)")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="self-healing drill: arm the in-process server's "
+                        "fault injector (serving/faults.py spec, e.g. "
+                        "'seed=11,engine_error=0.06,nan=0.06,kill=0.2'), "
+                        "then after the storm disarm and assert recovery "
+                        "— failures all attributable, no hangs, restarts "
+                        "in metrics, healthz back to ok, zero recompiles")
     args = p.parse_args()
+
+    if args.chaos and (args.url or args.video):
+        print("ERROR: --chaos drives the in-process pairwise drill "
+              "(no --url / --video)")
+        return 2
 
     if args.smoke:
         args.small = True
         args.iters = args.iters or 2
         args.size = (32, 48)
-        args.requests = min(args.requests, 24)
+        # chaos drills need enough traffic for the seeded arms to fire
+        # AND for clean availability to be a meaningful percentage
+        args.requests = min(args.requests, 64 if args.chaos else 24)
         args.clients = min(args.clients, 4)
         if args.video:
             args.frames = min(args.frames, 4)
@@ -503,12 +611,19 @@ def main() -> int:
             params = load_checkpoint_auto(args.load)
         else:
             params = init_raft(init_rng(), config)
+        # chaos drills shorten the recovery clocks so the smoke proves
+        # return-to-healthy in seconds, not the production 30s window
+        robustness = {}
+        if args.chaos:
+            robustness = dict(chaos=args.chaos, breaker_cooldown_s=2.0,
+                              degraded_window_s=2.0)
         sconfig = ServeConfig(
             buckets=parse_buckets(bucket_spec), max_batch=args.max_batch,
             max_wait_ms=args.max_wait_ms, queue_depth=args.queue_depth,
             default_deadline_ms=args.deadline_ms, port=0,
             iters_policy=args.iters_policy,
-            max_sessions=args.max_sessions if args.video else 0)
+            max_sessions=args.max_sessions if args.video else 0,
+            **robustness)
         server = FlowServer(config, params, sconfig, verbose=False)
         t0 = time.monotonic()
         server.start()
@@ -527,6 +642,13 @@ def main() -> int:
     else:
         results, elapsed = run_open(host, port, body, args.clients,
                                     args.requests, args.rate)
+
+    # chaos drill: storm is over — disarm, recover, audit (server alive)
+    chaos_rec, chaos_problems = None, []
+    if args.chaos and server is not None:
+        chaos_rec, chaos_problems = run_chaos_recovery(
+            args, host, port, server, results, body,
+            deadline_s=args.deadline_ms / 1000.0)
 
     # scrape the server's own view before shutdown
     conn = http.client.HTTPConnection(host, port, timeout=10)
@@ -595,6 +717,15 @@ def main() -> int:
             "p50": hist_percentile(prom, "raft_iters_used", 0.50),
             "p95": hist_percentile(prom, "raft_iters_used", 0.95),
         }
+    if chaos_rec is not None:
+        chaos_rec["fault_injected_total"] = {
+            k.split("=")[-1].strip('"}'): int(v) for k, v in prom.items()
+            if k.startswith("raft_fault_injected_total{")}
+        chaos_rec["batcher_restarts_metric"] = int(
+            prom.get("raft_batcher_restarts_total", 0))
+        chaos_rec["nonfinite_outputs"] = int(
+            prom.get("raft_nonfinite_outputs_total", 0))
+        rec["chaos"] = chaos_rec
     # provenance (OBSERVABILITY.md): every BENCH_serving.json record carries
     # the run manifest — git sha, jax versions, device, config hash — so the
     # serving trajectory is attributable.  For --url (external server) the
@@ -609,8 +740,8 @@ def main() -> int:
             f.write(json.dumps(rec) + "\n")
         print(f"[bench] appended to {args.out}")
 
-    if args.smoke:
-        problems = []
+    if args.smoke or chaos_problems:
+        problems = list(chaos_problems)
         if not ok_lat:
             problems.append("no successful requests")
         if rec["batch_size_mean"] <= 1.0 and args.clients > 1:
@@ -619,7 +750,7 @@ def main() -> int:
         if rec["compile_misses_after_warmup"] != 0:
             problems.append(f"{rec['compile_misses_after_warmup']} "
                             f"compile(s) after warmup")
-        if args.iters_policy and args.iters_policy != "fixed" \
+        if args.smoke and args.iters_policy and args.iters_policy != "fixed" \
                 and not args.url:
             # the adaptive-policy contract (in-process server only — an
             # external server's watchdogs aren't ours to assert on):
