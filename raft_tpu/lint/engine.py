@@ -66,6 +66,20 @@ class Rule:
                        severity or self.severity, message)
 
 
+class GlobalRule(Rule):
+    """A rule over the whole scan set, for analyses that cross file
+    boundaries (the C3 lock-order graph: the batcher acquires in one file
+    what the session store acquires in another).  Implement ``check_all``;
+    single-file scans (``scan_source``) fall back to it with a one-file
+    set, so fixtures and tests exercise the same code path."""
+
+    def check(self, ctx: "FileContext") -> Iterable[Finding]:
+        return self.check_all([ctx])
+
+    def check_all(self, ctxs: Sequence["FileContext"]) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
 RULES: Dict[str, Rule] = {}
 
 
@@ -235,23 +249,14 @@ class FileContext:
         """Only real COMMENT tokens count: a directive spelled inside a
         docstring or string literal (e.g. documentation examples) must not
         disable anything — otherwise any scanned file could defeat the CI
-        gate from inside a string."""
+        gate from inside a string.  Rides :func:`iter_suppressions` (the
+        same parser behind the CLI's --list-suppressions audit), so what
+        the engine honors and what the audit reports can never drift."""
         line_sup: Dict[int, Set[str]] = {}
         file_sup: Set[str] = set()
-        try:
-            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
-            comments = [(t.start[0], t.string) for t in tokens
-                        if t.type == tokenize.COMMENT]
-        except (tokenize.TokenError, IndentationError, SyntaxError):
-            return line_sup, file_sup    # unparseable handled as E999 anyway
-        for lineno, text in comments:
-            m = SUPPRESS_RE.search(text)
-            if not m:
-                continue
-            ids = {"all"} if m.group("rules") == "all" else \
-                {r.strip() for r in m.group("rules").split(",")}
-            if m.group("kind") == "disable-file":
-                file_sup |= ids
+        for lineno, kind, ids, _text in iter_suppressions(self.source):
+            if kind == "disable-file":
+                file_sup |= set(ids)
             else:
                 line_sup.setdefault(lineno, set()).update(ids)
         return line_sup, file_sup
@@ -340,9 +345,46 @@ def iter_python_files(paths: Sequence[str]) -> Iterator[Path]:
 def scan_paths(paths: Sequence[str],
                select: Optional[Sequence[str]] = None,
                ignore: Optional[Sequence[str]] = None) -> List[Finding]:
-    """Lint every .py file under ``paths`` (files or directories)."""
+    """Lint every .py file under ``paths`` (files or directories).
+    Per-file rules run per context; :class:`GlobalRule`s run once over the
+    whole context set (cross-file analysis sees every file of the scan)."""
     findings: List[Finding] = []
+    ctxs: List[FileContext] = []
     for f in iter_python_files(paths):
-        findings.extend(scan_source(f.read_text(encoding="utf-8"), str(f),
-                                    select=select, ignore=ignore))
-    return findings
+        try:
+            ctxs.append(FileContext(str(f), f.read_text(encoding="utf-8")))
+        except SyntaxError as e:
+            findings.append(Finding(str(f), e.lineno or 1, e.offset or 0,
+                                    "E999", "error",
+                                    f"syntax error: {e.msg}"))
+    by_path = {ctx.path: ctx for ctx in ctxs}
+    for rule in active_rules(select, ignore):
+        if isinstance(rule, GlobalRule):
+            produced = rule.check_all(ctxs)
+        else:
+            produced = (f for ctx in ctxs for f in rule.check(ctx))
+        for f in produced:
+            ctx = by_path.get(f.path)
+            if ctx is None or not ctx.is_suppressed(f):
+                findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule_id))
+
+
+def iter_suppressions(source: str):
+    """Yield ``(lineno, kind, rule_ids, comment_text)`` for every raftlint
+    suppression directive in ``source`` — real comment tokens only (same
+    contract as the engine's own suppression pass).  Backs the CLI's
+    ``--list-suppressions`` audit report."""
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [(t.start[0], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return
+    for lineno, text in comments:
+        m = SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        ids = ("all",) if m.group("rules") == "all" else \
+            tuple(sorted(r.strip() for r in m.group("rules").split(",")))
+        yield lineno, m.group("kind"), ids, text.strip()

@@ -33,6 +33,18 @@ Streaming steps (serving/stream.py) share this thread — ONE owner of the
 device — but execute per session via the injected ``stream_fn``: the
 queue keys them per session id, so a popped run is either all-pairwise
 (coalesced) or a single session's step, never a mix.
+
+Thread model (SERVING.md "Threading model"): the batcher deliberately
+holds **no lock of its own** — single ownership IS its synchronization.
+``batches``/``served``/``timed_out`` and ``_inflight_batch`` are written
+only on the loop thread (``restart()`` builds a new thread only after
+the old one has died, so single-writer holds across restarts); other
+threads only ever read them (serve_cli's exit line, /healthz, tests),
+which is why raftlint's C1/C6 — scoped to lock-HOLDING classes — do not
+apply here.  Everything shared it touches synchronizes on the owner's
+lock: the queue's (take_batch), the breaker's (record), the store's
+(attach/demote, inside stream_fn) — always one at a time, so the
+batcher thread can never hold two locks and can never deadlock.
 """
 
 from __future__ import annotations

@@ -24,12 +24,13 @@ State is exported as ``raft_breaker_state`` (0 closed, 1 half-open,
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from typing import Optional
 
+from ..lint.concurrency import guarded_by
 from ..telemetry.log import get_logger
+from ..telemetry.watchdogs import watched_lock
 from .queue import RejectedError
 
 _log = get_logger("serve")
@@ -54,7 +55,21 @@ class CircuitBreaker:
     counter pattern the session store uses for evictions); ``on_open`` is
     the server's degrade hook (demote streaming sessions).  ``clock`` is
     injectable so the state machine unit-tests run on a fake clock.
+
+    Thread model: ``record`` runs on the batcher thread, ``allow`` on
+    every handler thread, so the whole state machine lives under
+    ``_lock``.  The open transition calls ``on_open`` — which takes the
+    session store's lock to demote sessions — while ``_lock`` is held:
+    that is the breaker → store edge that pins this lock FIRST in the
+    declared hierarchy (lint.concurrency.SERVING_LOCK_HIERARCHY).
     """
+
+    _outcomes = guarded_by("_lock")
+    _state = guarded_by("_lock")
+    _opened_at = guarded_by("_lock")
+    _probes_left = guarded_by("_lock")
+    _last_probe_at = guarded_by("_lock")
+    opens = guarded_by("_lock")
 
     def __init__(self, window: int = 64, threshold: float = 0.5,
                  min_volume: int = 8, cooldown_s: float = 5.0,
@@ -74,7 +89,7 @@ class CircuitBreaker:
         self.clock = clock
         self.on_open = on_open
         self.transitions = None           # labeled counter, wired by metrics
-        self._lock = threading.Lock()
+        self._lock = watched_lock("CircuitBreaker._lock")
         self._outcomes = deque(maxlen=window)
         self._state = CLOSED
         self._opened_at = 0.0
@@ -93,8 +108,8 @@ class CircuitBreaker:
         """Gauge callback: 0 closed, 1 half-open, 2 open."""
         return _STATE_CODE[self.state]
 
+    @guarded_by("_lock")
     def _transition(self, state: str) -> None:
-        # lock held by the caller
         if state == self._state:
             return
         self._state = state

@@ -17,14 +17,15 @@ count by ServeConfig construction.
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from ..config import RAFTConfig, adaptive_iters
+from ..lint.concurrency import guarded_by
 from ..telemetry.log import get_logger
+from ..telemetry.watchdogs import watched_lock
 from .config import ServeConfig
 
 _log = get_logger("serve")
@@ -40,7 +41,25 @@ class InferenceEngine:
     ``iters_policy='converge:...'`` (ServeConfig override or model-config
     default) flow-producing executables return (…, iters_used): per-sample
     early exit runs INSIDE the compiled while_loop, so shapes — and
-    therefore the warm compile grid — never change with the data."""
+    therefore the warm compile grid — never change with the data.
+
+    Thread model (SERVING.md "Threading model"): device calls arrive on
+    the single batcher thread, but warmup runs on the server's start
+    thread and tests/tools call the engine directly, so every mutable
+    member is annotated and guarded — ``_lock`` for the executable cache
+    and the call counters (the 1-fnet-per-frame acceptance observables:
+    a dropped increment is a wrong benchmark), ``_spec_lock`` for the
+    feature-spec cache (separate lock because the serve-time miss path
+    compiles while holding ``_lock``, and a nested re-take of one
+    non-reentrant lock would deadlock — raftlint C3)."""
+
+    _exec = guarded_by("_lock")
+    compile_hits = guarded_by("_lock")
+    compile_misses = guarded_by("_lock")
+    pair_calls = guarded_by("_lock")
+    encode_calls = guarded_by("_lock")
+    stream_calls = guarded_by("_lock")
+    _feature_specs = guarded_by("_spec_lock")
 
     def __init__(self, config: RAFTConfig, params, sconfig: ServeConfig,
                  iters: Optional[int] = None, stream: bool = False,
@@ -92,7 +111,10 @@ class InferenceEngine:
             self._stream_fn = jax.jit(make_stream_step_fn(config,
                                                           iters=iters))
             self._feature_specs: Dict[Tuple[int, int, int], tuple] = {}
-        self._lock = threading.Lock()
+            self._spec_lock = watched_lock("InferenceEngine._spec_lock")
+        # budget None: a cold cache miss compiles while holding the lock
+        # (deliberate — see _get_executable), which busts any hold budget
+        self._lock = watched_lock("InferenceEngine._lock", budget_s=None)
         self._exec: Dict[Tuple[str, int, int, int, str], object] = {}
         self.compile_hits = 0
         self.compile_misses = 0
@@ -115,15 +137,24 @@ class InferenceEngine:
     def _feature_shapes(self, h: int, w: int, b: int):
         """Shape/dtype of the per-frame feature maps — derived from the
         model itself (jax.eval_shape over the encode fn), never hardcoded,
-        so bf16 compute or a variant change flows through automatically."""
+        so bf16 compute or a variant change flows through automatically.
+
+        The old bare ``if key not in ...: ... = ...`` here was the
+        check-then-act race raftlint C5 exists for: warmup (start thread)
+        and a first stream step (batcher thread) could both pass the
+        check.  eval_shape is pure and cheap, so losers just recompute;
+        ``setdefault`` under the lock keeps one canonical entry."""
         import jax
         import jax.numpy as jnp
         key = (h, w, b)
-        if key not in self._feature_specs:
+        with self._spec_lock:
+            spec = self._feature_specs.get(key)
+        if spec is None:
             img = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
-            self._feature_specs[key] = jax.eval_shape(
-                self._encode_fn, self.params, img)
-        return self._feature_specs[key]
+            spec = jax.eval_shape(self._encode_fn, self.params, img)
+            with self._spec_lock:
+                spec = self._feature_specs.setdefault(key, spec)
+        return spec
 
     def _compile(self, key: Tuple[str, int, int, int, str]):
         import jax
@@ -205,7 +236,8 @@ class InferenceEngine:
         h, w = bucket
         n = im1.shape[0]
         ex = self._get_executable(self._key(h, w, n))
-        self.pair_calls += 1
+        with self._lock:
+            self.pair_calls += 1
         if self.faults is not None:
             self.faults.pre_engine_call()
         out = ex(self.params, im1, im2)
@@ -227,7 +259,8 @@ class InferenceEngine:
         host: they are the session cache."""
         h, w = bucket
         ex = self._get_executable(self._key(h, w, image.shape[0], "encode"))
-        self.encode_calls += 1
+        with self._lock:
+            self.encode_calls += 1
         if self.faults is not None:
             self.faults.pre_engine_call()
         return ex(self.params, image)
@@ -241,7 +274,8 @@ class InferenceEngine:
         ``encode_calls``/``stream_calls``."""
         h, w = bucket
         ex = self._get_executable(self._key(h, w, image.shape[0], "stream"))
-        self.stream_calls += 1
+        with self._lock:
+            self.stream_calls += 1
         if self.faults is not None:
             self.faults.pre_engine_call()
         out = ex(self.params, image, fmap_prev, cnet_prev, flow_init)

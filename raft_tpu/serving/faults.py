@@ -36,14 +36,15 @@ its own seeded RandomState, so a drill replays.  Fires are counted in
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from collections import deque
 from typing import Dict, Optional
 
 import numpy as np
 
+from ..lint.concurrency import guarded_by
 from ..telemetry.log import get_logger
+from ..telemetry.watchdogs import watched_lock
 
 _log = get_logger("serve")
 
@@ -130,11 +131,15 @@ class FaultInjector:
     while disarmed.
     """
 
+    _forced = guarded_by("_lock")
+    _armed = guarded_by("_lock")
+    injected = guarded_by("_lock")
+
     def __init__(self, spec: ChaosSpec, counter=None, run_log=None):
         self.spec = spec
         self.counter = counter            # raft_fault_injected_total{arm=}
         self.run_log = run_log            # telemetry.events.RunLog or None
-        self._lock = threading.Lock()
+        self._lock = watched_lock("FaultInjector._lock")
         self._rng = {arm: np.random.RandomState(_arm_seed(spec.seed, arm))
                      for arm in ARMS}
         self._row_rng = np.random.RandomState(_arm_seed(spec.seed, "row"))

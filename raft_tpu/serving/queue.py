@@ -21,6 +21,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..lint.concurrency import guarded_by
+from ..telemetry.watchdogs import watched_lock
+
 
 class RejectedError(Exception):
     """Base: request refused before reaching the device.  ``retry_after``
@@ -103,11 +106,21 @@ class Request:
 
 
 class RequestQueue:
-    """Bounded multi-bucket FIFO shared by submitters and the batcher."""
+    """Bounded multi-bucket FIFO shared by submitters and the batcher.
+
+    Thread model: HTTP handler threads ``submit``; the batcher thread
+    ``take_batch``es (and waits on ``_cond``, which wraps — i.e. aliases —
+    ``_lock``).  Everything mutable is guarded by ``_lock``; a stream
+    handler submits while holding its session lock, so in the declared
+    hierarchy this lock sits INSIDE ``Session.lock`` (SERVING.md)."""
+
+    _by_bucket = guarded_by("_lock")
+    _size = guarded_by("_lock")
+    _closed = guarded_by("_lock")
 
     def __init__(self, depth: int):
         self.depth = depth
-        self._lock = threading.Lock()
+        self._lock = watched_lock("RequestQueue._lock")
         self._cond = threading.Condition(self._lock)
         self._by_bucket: Dict[Tuple[int, int], List[Request]] = {}
         self._size = 0
@@ -139,6 +152,7 @@ class RequestQueue:
         with self._lock:
             return self._closed
 
+    @guarded_by("_lock")
     def _purge_expired_locked(self, now: float) -> List[Request]:
         expired = []
         for bucket, fifo in list(self._by_bucket.items()):

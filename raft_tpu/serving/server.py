@@ -26,6 +26,7 @@ import numpy as np
 
 from ..config import RAFTConfig
 from ..data.pipeline import pad_to_shape
+from ..lint.concurrency import SERVING_LOCK_HIERARCHY
 from ..telemetry import events as tlm_events
 from ..telemetry import watchdogs as tlm_watchdogs
 from ..telemetry.log import get_logger
@@ -270,6 +271,15 @@ class FlowServer:
             self.registry.gauge("raft_serving_compile_cache_entries",
                                 "Warm executables resident",
                                 fn=self.engine_executables)
+            if tlm_watchdogs.lock_watch_enabled():
+                # runtime lock-order validator (RAFT_TPU_LOCK_WATCH=1):
+                # the serving locks were created through watched_lock, so
+                # every acquisition edge is recorded — arm the declared
+                # hierarchy (SERVING.md threading model) and export the
+                # violation counters; the chaos drill asserts they stay 0
+                v = tlm_watchdogs.export_lock_metrics(
+                    self.registry, run_log=tlm_events.current())
+                v.declare_order(SERVING_LOCK_HIERARCHY)
         if tlm_watchdogs.watchdogs_enabled():
             # stack-wide XLA compile listener (the serving engine's own
             # hit/miss counters see only its executables; this one also

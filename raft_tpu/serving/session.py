@@ -29,11 +29,13 @@ designed behavior, not a race.
 
 from __future__ import annotations
 
-import threading
 import time
 import uuid
 from collections import OrderedDict
 from typing import Optional, Tuple
+
+from ..lint.concurrency import guarded_by
+from ..telemetry.watchdogs import watched_lock
 
 # Demoted records (host tier only) are kept for graceful cold restarts up
 # to this multiple of max_sessions; beyond it the oldest records are
@@ -51,7 +53,10 @@ class Session:
     def __init__(self, sid: str, bucket: Tuple[int, int]):
         self.id = sid
         self.bucket = bucket
-        self.lock = threading.Lock()
+        # budget None: the handler deliberately holds this across a whole
+        # advance (queue wait + device call) — serializing frames within a
+        # session is the lock's JOB, not a hold-time bug
+        self.lock = watched_lock("Session.lock", budget_s=None)
         self.created_at = self.last_used = time.monotonic()
         self.frames = 0                  # advances served (pairs)
         self.last_image = None           # [1, BH, BW, 3] float32, host
@@ -68,7 +73,15 @@ class Session:
 
 
 class SessionStore:
-    """LRU + TTL bounded session registry (one per FlowServer)."""
+    """LRU + TTL bounded session registry (one per FlowServer).
+
+    ``_lock`` guards the registry itself (``_sessions`` order and
+    membership); per-``Session`` state is serialized by ``Session.lock``
+    plus the single batcher thread (see the module docstring).  The store
+    only ever *probes* ``Session.lock.locked()`` under its own lock —
+    never acquires it — so the two can't order-invert."""
+
+    _sessions = guarded_by("_lock")
 
     def __init__(self, max_sessions: int, ttl_s: float):
         if max_sessions < 1:
@@ -79,7 +92,7 @@ class SessionStore:
         self.max_sessions = max_sessions
         self.record_cap = RECORD_CAP_FACTOR * max_sessions
         self.ttl_s = ttl_s
-        self._lock = threading.Lock()
+        self._lock = watched_lock("SessionStore._lock")
         self._sessions: "OrderedDict[str, Session]" = OrderedDict()
         # set by make_stream_metrics: a labeled counter with reason=
         # lru (features demoted), ttl (record reaped), capacity (record
@@ -186,6 +199,7 @@ class SessionStore:
                     n += 1
         return n
 
+    @guarded_by("_lock")
     def _pop_lru_locked(self) -> Optional[Session]:
         for sid, s in self._sessions.items():
             if not s.lock.locked():

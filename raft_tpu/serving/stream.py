@@ -15,6 +15,16 @@ keyed per session so they never coalesce with pairwise batches; the
 session lock serializes frames within a session (a concurrent advance on
 the same session answers 409 rather than reordering the recurrence).
 
+Thread model (SERVING.md "Threading model"): the handler thread holds
+``Session.lock`` across the WHOLE advance — including ``queue.submit``
+(which takes the queue lock) and the blocking wait — which is why the
+declared hierarchy orders ``Session.lock`` OUTSIDE
+``RequestQueue._lock``.  The coordinator itself holds no lock: session
+state is mutated only in :meth:`execute` on the batcher thread, while
+the handler's session lock keeps any second frame of the same session
+out; ``store._evict`` (a thread-safe counter inc) is the only store
+touch made without the store lock.
+
 Evicted (demoted) sessions degrade transparently: the advance re-encodes
 the retained previous frame — the cold two-encoder cost, the same flow.
 """
@@ -101,12 +111,16 @@ class StreamCoordinator:
                 f"no declared bucket fits ({h}, {w}); buckets: "
                 f"{[f'{bh}x{bw}' for bh, bw in self.sconfig.buckets]}")
         s = self.store.open(bucket)
-        with s.lock:
-            try:
+        try:
+            with s.lock:
                 self._run_step(s, "open", image, deadline_ms)
-            except BaseException:
-                self.store.close(s.id)   # no half-open sessions
-                raise
+        except BaseException:
+            # no half-open sessions — but close AFTER releasing s.lock:
+            # store.close takes the store lock, which the hierarchy orders
+            # OUTSIDE the session lock (the id never reached the client,
+            # so nothing can race the record between release and close)
+            self.store.close(s.id)
+            raise
         self.metrics["opens"].inc()
         return {"session": s.id, "frame": 0,
                 "meta": {"bucket": list(bucket)}}

@@ -1,7 +1,12 @@
-"""raftlint suite tests: every rule R1-R9 fires on a seeded bad fixture and
-is silenced by ``# raftlint: disable=RX``; good twins stay clean; the
-shape/dtype contract machinery parses, enforces, and reports; and the repo
-itself scans clean under --strict (the CI gate, marked ``lint``).
+"""raftlint suite tests: every rule (R1-R10 JAX hazards + C1-C6 lock
+discipline) fires on a seeded bad fixture and is silenced by ``# raftlint:
+disable=RX``; good twins stay clean; the shape/dtype contract machinery
+parses, enforces, and reports; the guard-annotation layer
+(lint.concurrency.guarded_by) creates and honors guard maps; the CLI's
+--diff/baseline/--list-suppressions satellite modes work end to end; the
+SERVING.md threading model (hierarchy + lock table) is generated-checked
+against the annotations; and the repo itself scans clean under --strict
+(the CI gate, marked ``lint``).
 
 No jax import is needed for the engine tests — the linter is pure AST.
 """
@@ -290,6 +295,193 @@ def load_dataset(path, verbose=True):
         _log.info(f"scanning {path}")
     return path
 """),
+    # ---- the concurrency family (C1-C6): lock-holding classes only ----
+    ("C1", """
+import threading
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = {}
+
+    def add(self, k, v):
+        with self._lock:
+            self.items[k] = v
+
+    def reset(self):
+        self.items = {}
+""", """
+import threading
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = {}
+
+    def add(self, k, v):
+        with self._lock:
+            self.items[k] = v
+
+    def reset(self):
+        with self._lock:
+            self.items = {}
+"""),
+    ("C2", """
+import threading
+import time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def refresh(self):
+        with self._lock:
+            time.sleep(0.1)
+            self.value += 1
+""", """
+import threading
+import time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def refresh(self):
+        time.sleep(0.1)
+        with self._lock:
+            self.value += 1
+"""),
+    ("C3", """
+import threading
+
+class FeatureStore:
+    def __init__(self, tripper):
+        self._lock = threading.Lock()
+        self.tripper = tripper
+        self.n = 0
+
+    def evict_one(self):
+        with self._lock:
+            self.tripper.trip()
+
+class Tripper:
+    def __init__(self, store):
+        self._lock = threading.Lock()
+        self.store = store
+        self.n = 0
+
+    def trip(self):
+        with self._lock:
+            self.n += 1
+
+    def open_all(self):
+        with self._lock:
+            self.store.evict_one()
+""", """
+import threading
+
+class FeatureStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def evict_one(self):
+        with self._lock:
+            self.n += 1
+
+class Tripper:
+    def __init__(self, store):
+        self._lock = threading.Lock()
+        self.store = store
+        self.n = 0
+
+    def trip(self):
+        with self._lock:
+            self.n += 1
+
+    def open_all(self):
+        with self._lock:
+            self.store.evict_one()
+"""),
+    ("C4", """
+import threading
+
+class Inbox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.items = []
+
+    def take(self):
+        with self._cond:
+            if not self.items:
+                self._cond.wait()
+            return self.items.pop()
+""", """
+import threading
+
+class Inbox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.items = []
+
+    def take(self):
+        with self._cond:
+            while not self.items:
+                self._cond.wait()
+            return self.items.pop()
+"""),
+    ("C5", """
+import threading
+
+class LazyCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}
+
+    def lookup(self, key):
+        if key not in self._cache:
+            self._cache[key] = key * 2
+        return self._cache[key]
+""", """
+import threading
+
+class LazyCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}
+
+    def lookup(self, key):
+        with self._lock:
+            if key not in self._cache:
+                self._cache[key] = key * 2
+            return self._cache[key]
+"""),
+    ("C6", """
+import threading
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.calls = 0
+
+    def record(self):
+        self.calls += 1
+""", """
+import threading
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.calls = 0
+
+    def record(self):
+        with self._lock:
+            self.calls += 1
+"""),
 ]
 
 
@@ -383,6 +575,173 @@ def f(x):
     found = ids(scan_source(src))
     assert "R1" in found
     assert "R10" not in found
+
+
+def test_c1_guarded_by_annotation_creates_and_silences_guards():
+    """The explicit annotation layer: a class-level guarded_by() puts an
+    attribute in the guard map even when inference can't see it, and a
+    @guarded_by method decorator marks its whole body as lock-held."""
+    src = """
+import threading
+from raft_tpu.lint.concurrency import guarded_by
+
+class Engine:
+    hits = guarded_by("_lock")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    def bump(self):
+        self.hits = self.hits + 1
+"""
+    assert "C1" in ids(scan_source(src))
+    fixed = src.replace("    def bump(self):",
+                        "    @guarded_by(\"_lock\")\n    def bump(self):")
+    assert "C1" not in ids(scan_source(fixed))
+
+
+def test_c2_wait_while_holding_second_lock():
+    """Waiting on our own condition with exactly its lock held is the
+    protocol; holding ANOTHER lock across the wait blocks every thread."""
+    ok = """
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.items = []
+
+    def take(self):
+        with self._cond:
+            while not self.items:
+                self._cond.wait()
+"""
+    assert "C2" not in ids(scan_source(ok))
+    bad = """
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._other = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.items = []
+
+    def take(self):
+        with self._other:
+            with self._cond:
+                while not self.items:
+                    self._cond.wait()
+"""
+    assert "C2" in ids(scan_source(bad))
+
+
+def test_c3_self_deadlock_and_declared_hierarchy_inversion():
+    deadlock = """
+import threading
+
+class E:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def run(self):
+        with self._lock:
+            with self._lock:
+                self.n += 1
+"""
+    found = [f for f in scan_source(deadlock) if f.rule_id == "C3"]
+    assert found and "re-acquires" in found[0].message
+    # class/lock names from the DECLARED serving hierarchy
+    # (lint.concurrency.SERVING_LOCK_HIERARCHY): store holds its lock and
+    # calls into the breaker -> inner-acquires an OUTER lock = inversion,
+    # flagged before any cycle exists
+    inversion = """
+import threading
+
+class SessionStore:
+    def __init__(self, breaker):
+        self._lock = threading.Lock()
+        self.breaker = breaker
+        self.n = 0
+
+    def sweep_all(self):
+        with self._lock:
+            self.breaker.trip_now()
+
+class CircuitBreaker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def trip_now(self):
+        with self._lock:
+            self.n += 1
+"""
+    found = [f for f in scan_source(inversion) if f.rule_id == "C3"]
+    assert found and "inversion" in found[0].message
+
+
+def test_c_rules_scoped_to_lock_holding_classes():
+    """No lock declared = no shared-state statement = no C findings, even
+    for patterns that would fire on a threaded class."""
+    src = """
+class Plain:
+    def __init__(self):
+        self.cache = {}
+        self.calls = 0
+
+    def lookup(self, k):
+        if k not in self.cache:
+            self.cache[k] = k * 2
+        self.calls += 1
+        return self.cache[k]
+"""
+    assert not {r for r in ids(scan_source(src)) if r.startswith("C")}
+
+
+def test_watched_lock_constructor_counts_as_a_lock():
+    """Serving locks are created via telemetry.watchdogs.watched_lock —
+    the analysis must keep seeing them as locks or the whole C family
+    goes blind exactly where it matters."""
+    src = """
+from raft_tpu.telemetry.watchdogs import watched_lock
+
+class Store:
+    def __init__(self):
+        self._lock = watched_lock("Store._lock")
+        self.items = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self.items[k] = v
+
+    def wipe(self):
+        self.items = {}
+"""
+    assert "C1" in ids(scan_source(src))
+
+
+def test_serving_lock_hierarchy_is_consistent_with_static_edges():
+    """The declared hierarchy (annotated in the serving modules, armed
+    into the runtime validator) must agree with every statically
+    extracted acquisition edge of the actual serving code."""
+    from raft_tpu.lint import concurrency as conc
+    from raft_tpu.lint.engine import FileContext, iter_python_files
+    all_classes = []
+    for f in iter_python_files([str(REPO / "raft_tpu")]):
+        ctx = FileContext(str(f), f.read_text(encoding="utf-8"))
+        all_classes.extend((ctx, c) for c in conc.analyze_classes(ctx))
+    edges, _ = conc.build_lock_graph(all_classes)
+    assert not conc.find_cycles(edges)
+    for src, dst, node, path in edges:
+        rs, rd = conc.hierarchy_rank(src), conc.hierarchy_rank(dst)
+        if rs is not None and rd is not None:
+            assert rs < rd, (f"edge {src} -> {dst} at {path}:"
+                             f"{node.lineno} inverts the declared "
+                             f"hierarchy")
 
 
 def test_eight_plus_distinct_rules_covered():
@@ -561,6 +920,125 @@ def test_fused_kernel_contract_pins_float32():
 
 
 # ---------------------------------------------------------------------------
+# CLI: --diff changed-files mode, findings baseline, suppression audit
+# ---------------------------------------------------------------------------
+
+RAFTLINT = str(REPO / "tools" / "raftlint.py")
+BAD_PRNG = "import jax\nk = jax.random.PRNGKey(0)\n"
+
+
+def _run(args, cwd=None):
+    return subprocess.run([sys.executable, RAFTLINT, *args],
+                          capture_output=True, text=True, cwd=cwd)
+
+
+@pytest.fixture
+def tmp_git_repo(tmp_path):
+    """A throwaway git repo with one committed clean file."""
+    def git(*a):
+        r = subprocess.run(["git", "-c", "user.email=t@t", "-c",
+                            "user.name=t", *a], cwd=tmp_path,
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        return r.stdout
+    git("init", "-q")
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    git("add", "clean.py")
+    git("commit", "-qm", "seed")
+    return tmp_path, git
+
+
+def test_diff_mode_scans_only_changed_files(tmp_git_repo, monkeypatch):
+    tmp_path, git = tmp_git_repo
+    import tools.raftlint as rl
+    monkeypatch.setattr(rl, "REPO_ROOT", tmp_path)
+    # nothing changed: clean exit, clean.py not rescanned
+    assert rl.main(["--diff", "HEAD", "--strict", str(tmp_path)]) == 0
+    # a changed tracked file with a finding fails the strict diff gate
+    (tmp_path / "clean.py").write_text(BAD_PRNG)
+    assert rl.main(["--diff", "HEAD", "--strict", str(tmp_path)]) == 1
+    # an untracked file is scanned too (pre-commit covers new files)
+    git("checkout", "-q", "--", "clean.py")
+    (tmp_path / "fresh.py").write_text(BAD_PRNG)
+    assert rl.main(["--diff", "HEAD", "--strict", str(tmp_path)]) == 1
+
+
+def test_baseline_accepts_known_findings_not_new_ones(tmp_path, monkeypatch):
+    import tools.raftlint as rl
+    monkeypatch.setattr(rl, "REPO_ROOT", tmp_path)
+    bad = tmp_path / "legacy.py"
+    bad.write_text(BAD_PRNG)
+    baseline = tmp_path / "LINT_BASELINE.json"
+    # accept the current findings, then the gate passes on them
+    assert rl.main(["--write-baseline", "--baseline", str(baseline),
+                    str(bad)]) == 0
+    assert baseline.exists()
+    assert rl.main(["--strict", "--baseline", str(baseline),
+                    str(bad)]) == 0
+    # a NEW finding in the same file still fails (line-number drift is
+    # fine — fingerprints key on the source text, not the line)
+    bad.write_text("\n\n" + BAD_PRNG
+                   + "k2 = jax.random.PRNGKey(1)\n")
+    assert rl.main(["--strict", "--baseline", str(baseline),
+                    str(bad)]) == 1
+    # --no-baseline restores full strictness
+    bad.write_text(BAD_PRNG)
+    assert rl.main(["--strict", "--baseline", str(baseline),
+                    "--no-baseline", str(bad)]) == 1
+
+
+def test_committed_baseline_is_empty_and_schema_versioned():
+    """The committed baseline documents 'zero known findings' — the tree
+    must actually scan clean, so the baseline never hides anything."""
+    import json as _json
+    doc = _json.loads((REPO / "LINT_BASELINE.json").read_text())
+    assert doc["version"] == 1
+    assert doc["findings"] == []
+
+
+def test_list_suppressions_reports_rule_file_line(tmp_path):
+    f = tmp_path / "sup.py"
+    f.write_text("import jax\n"
+                 "k = jax.random.PRNGKey(0)  # raftlint: disable=R3\n"
+                 "# raftlint: disable-file=C6\n")
+    r = _run(["--list-suppressions", str(f)])
+    assert r.returncode == 0, r.stderr
+    assert "R3" in r.stdout and "sup.py:2" in r.stdout
+    assert "C6" in r.stdout and "disable-file" in r.stdout
+    assert "2 suppression(s)" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# SERVING.md threading model: generated-checked against the annotations
+# ---------------------------------------------------------------------------
+
+def test_serving_md_lock_hierarchy_matches_declaration():
+    from raft_tpu.lint.concurrency import SERVING_LOCK_HIERARCHY
+    doc = (REPO / "SERVING.md").read_text()
+    expected = " → ".join(f"`{n}`" for n in SERVING_LOCK_HIERARCHY)
+    assert expected in doc, (
+        "SERVING.md threading-model hierarchy drifted from "
+        "lint.concurrency.SERVING_LOCK_HIERARCHY — update the doc line to:"
+        f"\n{expected}")
+
+
+def test_serving_md_lock_table_matches_annotations():
+    """The 'which attributes each lock guards' table in SERVING.md is
+    generated from the guarded_by annotations + inference; regenerating
+    it must reproduce the committed text exactly."""
+    from raft_tpu.lint.concurrency import render_threading_table
+    doc = (REPO / "SERVING.md").read_text()
+    start = doc.index("<!-- lock-table:start -->")
+    end = doc.index("<!-- lock-table:end -->")
+    committed = doc[start + len("<!-- lock-table:start -->"):end].strip()
+    generated = render_threading_table(
+        [str(REPO / "raft_tpu" / "serving")]).strip()
+    assert committed == generated, (
+        "SERVING.md lock table drifted from the annotations — replace the "
+        "block between the lock-table markers with:\n\n" + generated)
+
+
+# ---------------------------------------------------------------------------
 # the repo itself
 # ---------------------------------------------------------------------------
 
@@ -568,6 +1046,17 @@ def test_fused_kernel_contract_pins_float32():
 def test_self_scan_repo_is_clean():
     findings = scan_paths([str(REPO / "raft_tpu")])
     assert not findings, "\n".join(f.format() for f in findings)
+
+
+@pytest.mark.lint
+def test_self_scan_c_family_runs_and_is_clean():
+    """The concurrency family specifically (the strict gate above covers
+    it too, but this pins that C1-C6 actually RUN on the tree — a
+    regression that unregistered them would otherwise pass silently)."""
+    c_rules = [f"C{i}" for i in range(1, 7)]
+    findings = scan_paths([str(REPO / "raft_tpu")], select=c_rules)
+    assert not findings, "\n".join(f.format() for f in findings)
+    assert set(c_rules) <= set(RULES)
 
 
 @pytest.mark.lint

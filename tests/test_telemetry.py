@@ -318,6 +318,167 @@ def test_recompile_watch_counts_and_attributes_stage(tmp_path):
                and r.get("stage") == "eval/forward" for r in recs)
 
 
+def test_lock_validator_clean_nesting_is_zero_violations():
+    """A consistently ordered drill records edges, holds, and NOTHING
+    else — the chaos smoke's zero-violation assertion in unit form."""
+    import threading
+    v = wd.LockOrderValidator(hold_budget_s=1.0, log_fn=lambda m: None)
+    a = wd.WatchedLock("A", threading.Lock(), v)
+    b = wd.WatchedLock("B", threading.Lock(), v)
+
+    def worker():
+        for _ in range(20):
+            with a:
+                with b:
+                    pass
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    counts = v.counts()
+    assert counts["order_violations"] == 0
+    assert counts["hold_violations"] == 0
+    assert counts["edges"] == 1             # A->B, deduped
+
+
+def test_lock_validator_forced_inversion_fires_once():
+    import threading
+    v = wd.LockOrderValidator(log_fn=lambda m: None)
+    a = wd.WatchedLock("A", threading.Lock(), v)
+    b = wd.WatchedLock("B", threading.Lock(), v)
+    with a:
+        with b:
+            pass
+    for _ in range(3):                      # the cycle edge is deduped:
+        with b:                             # counted once, not per hit
+            with a:
+                pass
+    assert v.counts()["order_violations"] == 1
+    assert v.violations[0]["kind"] == "order"
+    assert "cycle" in v.violations[0]["msg"]
+
+
+def test_lock_validator_declared_hierarchy_catches_first_inversion():
+    """With the serving hierarchy declared, the FIRST wrong-way edge is a
+    violation — no need to wait for the matching opposite edge to land in
+    a later PR and close an actual deadlock."""
+    import threading
+    v = wd.LockOrderValidator(log_fn=lambda m: None)
+    v.declare_order(("outer", "inner"))
+    outer = wd.WatchedLock("outer", threading.Lock(), v)
+    inner = wd.WatchedLock("inner", threading.Lock(), v)
+    with inner:
+        with outer:
+            pass
+    assert v.counts()["order_violations"] == 1
+    assert "inversion" in v.violations[0]["msg"]
+    # reentry of a non-reentrant lock is also a (deadlock-shaped) violation
+    v2 = wd.LockOrderValidator(log_fn=lambda m: None)
+    r = wd.WatchedLock("R", threading.Lock(), v2)
+    v2.on_acquired("R")                     # simulate: a real Lock would
+    v2.on_acquired("R")                     # already be deadlocked here
+    assert v2.violations[0]["kind"] == "reentry"
+
+
+def test_lock_validator_hold_budget_and_condition_wait_exempt():
+    import threading
+    t = [0.0]
+    v = wd.LockOrderValidator(clock=lambda: t[0], hold_budget_s=0.5,
+                              log_fn=lambda m: None)
+    lk = wd.WatchedLock("L", threading.Lock(), v)
+    lk.acquire()
+    t[0] += 2.0
+    lk.release()
+    assert v.counts()["hold_violations"] == 1
+    v.set_budget("L", None)                 # per-lock opt-out (Session.lock)
+    lk.acquire()
+    t[0] += 10.0
+    lk.release()
+    assert v.counts()["hold_violations"] == 1
+    # Condition.wait releases the wrapped lock: a long wait is NOT a hold
+    v2 = wd.LockOrderValidator(hold_budget_s=0.2, log_fn=lambda m: None)
+    wl = wd.WatchedLock("C", threading.Lock(), v2)
+    cond = threading.Condition(wl)
+    ready = []
+
+    def waiter():
+        with cond:
+            while not ready:
+                cond.wait(timeout=5)
+    th = threading.Thread(target=waiter)
+    th.start()
+    import time as _time
+    _time.sleep(0.4)                        # waiter parked > budget
+    with cond:
+        ready.append(1)
+        cond.notify()
+    th.join()
+    assert v2.counts()["hold_violations"] == 0
+    assert v2.counts()["order_violations"] == 0
+
+
+def test_watched_lock_env_gate_and_metrics_export(monkeypatch):
+    import threading
+    monkeypatch.delenv("RAFT_TPU_LOCK_WATCH", raising=False)
+    assert isinstance(wd.watched_lock("X"), type(threading.Lock()))
+    monkeypatch.setenv("RAFT_TPU_LOCK_WATCH", "1")
+    assert isinstance(wd.watched_lock("X"), wd.WatchedLock)
+    # export: live families on a registry, backed by the validator
+    v = wd.LockOrderValidator(log_fn=lambda m: None)
+    reg = Registry()
+    wd.export_lock_metrics(reg, validator=v)
+    a = wd.WatchedLock("A", threading.Lock(), v)
+    b = wd.WatchedLock("B", threading.Lock(), v)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    text = reg.render()
+    assert "raft_lock_order_violations_total 1" in text
+    assert "raft_lock_hold_seconds_count 4" in text
+
+
+def test_stream_open_failure_path_respects_lock_hierarchy(monkeypatch):
+    """Regression: a failed session open (queue full) used to close the
+    session record while still holding Session.lock — store.close takes
+    the store lock, inverting the declared hierarchy.  The close now runs
+    after the session lock is released: zero violations, and the
+    half-open record is still cleaned up."""
+    import threading  # noqa: F401 — locks built via watched_lock below
+    monkeypatch.setenv("RAFT_TPU_LOCK_WATCH", "1")
+    fresh = wd.LockOrderValidator(log_fn=lambda m: None)
+    monkeypatch.setattr(wd, "_validator", fresh)
+    from raft_tpu.lint.concurrency import SERVING_LOCK_HIERARCHY
+    fresh.declare_order(SERVING_LOCK_HIERARCHY)
+    from raft_tpu.serving.queue import QueueFull
+    from raft_tpu.serving.session import SessionStore
+    from raft_tpu.serving.stream import StreamCoordinator
+
+    class FullQueue:
+        def submit(self, req):
+            raise QueueFull("full")
+
+    class SConfig:
+        session_ttl_s = 60.0
+        default_deadline_ms = 100.0
+
+        def route(self, h, w):
+            return (32, 48)
+
+    statuses = []
+    store = SessionStore(2, 60.0)
+    coord = StreamCoordinator(store, SConfig(), FullQueue(), {},
+                              statuses.append)
+    with pytest.raises(QueueFull):
+        coord.open(np.zeros((24, 40, 3), np.float32), None)
+    assert statuses == ["shed"]
+    assert store.resident_count() == 0      # no half-open session leaked
+    assert fresh.counts()["order_violations"] == 0, fresh.violations
+
+
 def test_hbm_gauges_none_safe():
     reg = Registry()
     gauges = wd.hbm_gauges(reg)

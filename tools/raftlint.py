@@ -1,20 +1,33 @@
 #!/usr/bin/env python
-"""raftlint CLI: scan the package for JAX hazards (see LINT.md).
+"""raftlint CLI: scan the package for JAX + concurrency hazards (LINT.md).
 
     python tools/raftlint.py                    # scan raft_tpu/, report
     python tools/raftlint.py --strict           # exit 1 on ANY finding (CI)
-    python tools/raftlint.py path/to/file.py --select R3,R7
+    python tools/raftlint.py path/to/file.py --select R3,C1
     python tools/raftlint.py --list-rules
     python tools/raftlint.py --contracts        # dump @contract'd signatures
+    python tools/raftlint.py --diff             # changed files only (vs HEAD)
+    python tools/raftlint.py --diff origin/main --strict   # pre-commit gate
+    python tools/raftlint.py --write-baseline   # accept current findings
+    python tools/raftlint.py --list-suppressions  # audit disable= escapes
 
 Pure stdlib + AST: nothing is imported or executed from the scanned tree,
 so this runs in well under a second with or without jax installed.
+
+``--diff [REV]`` scans only the .py files changed vs REV (plus untracked
+files), so the strict gate stays fast as the tree grows and works as a
+pre-commit hook.  The committed baseline (``LINT_BASELINE.json``) is
+applied automatically in ``--diff`` mode — known findings in a touched
+file don't fail the gate, NEW ones do; ``--baseline`` points elsewhere,
+``--no-baseline`` disables.  Fingerprints are (path, rule, stripped
+source line), so reflowing unrelated lines doesn't churn the baseline.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
 
@@ -22,6 +35,8 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
 
 from raft_tpu.lint import engine  # noqa: E402
+
+DEFAULT_BASELINE = REPO_ROOT / "LINT_BASELINE.json"
 
 
 def _list_rules() -> None:
@@ -46,9 +61,131 @@ def _dump_contracts(paths) -> None:
                                   for k, v in rendered.items()))
 
 
+def _git(*argv: str):
+    """Run git in the repo root; (returncode, stdout)."""
+    r = subprocess.run(["git", *argv], capture_output=True, text=True,
+                       cwd=str(REPO_ROOT))
+    return r.returncode, r.stdout
+
+
+def _changed_files(rev: str, paths) -> list:
+    """.py files changed vs ``rev`` (deletions excluded) plus untracked
+    ones, intersected with the requested scan paths."""
+    rc, diff = _git("diff", "--name-only", "--diff-filter=d", rev, "--")
+    if rc != 0:
+        raise RuntimeError(f"git diff {rev} failed — is {rev!r} a valid "
+                           f"revision of this repo?")
+    _, untracked = _git("ls-files", "--others", "--exclude-standard")
+    roots = [Path(p).resolve() for p in paths]
+    out = []
+    for name in sorted(set(diff.splitlines() + untracked.splitlines())):
+        f = (REPO_ROOT / name).resolve()
+        if f.suffix != ".py" or not f.exists():
+            continue
+        if any(r == f or r in f.parents for r in roots):
+            out.append(str(f))
+    return out
+
+
+def _fingerprint(finding, source_lines: dict) -> tuple:
+    """Line-number-independent identity of a finding: (relative path,
+    rule, stripped source text of the flagged line)."""
+    try:
+        rel = str(Path(finding.path).resolve().relative_to(REPO_ROOT))
+    except ValueError:
+        rel = finding.path
+    lines = source_lines.get(finding.path)
+    text = ""
+    if lines and 1 <= finding.line <= len(lines):
+        text = lines[finding.line - 1].strip()
+    return (rel, finding.rule_id, text)
+
+
+def _load_source_lines(findings) -> dict:
+    lines = {}
+    for f in findings:
+        if f.path not in lines:
+            try:
+                lines[f.path] = Path(f.path).read_text(
+                    encoding="utf-8").splitlines()
+            except OSError:
+                lines[f.path] = []
+    return lines
+
+
+def _apply_baseline(findings, baseline_path: Path):
+    """Split findings into (new, known) against the committed baseline."""
+    try:
+        doc = json.loads(baseline_path.read_text())
+    except OSError:
+        return findings, []
+    known = {}
+    for rec in doc.get("findings", []):
+        key = (rec["path"], rec["rule"], rec["line_text"])
+        known[key] = known.get(key, 0) + 1
+    lines = _load_source_lines(findings)
+    new, matched = [], []
+    for f in findings:
+        key = _fingerprint(f, lines)
+        if known.get(key, 0) > 0:
+            known[key] -= 1
+            matched.append(f)
+        else:
+            new.append(f)
+    return new, matched
+
+
+def _write_baseline(findings, baseline_path: Path) -> None:
+    lines = _load_source_lines(findings)
+    recs = [{"path": k[0], "rule": k[1], "line_text": k[2]}
+            for k in sorted(_fingerprint(f, lines) for f in findings)]
+    baseline_path.write_text(json.dumps(
+        {"version": 1,
+         "comment": "raftlint findings baseline: known findings listed "
+                    "here do not fail --diff/--baseline gates; new ones "
+                    "do. Regenerate with tools/raftlint.py "
+                    "--write-baseline. Keep at zero findings.",
+         "findings": recs}, indent=2) + "\n")
+    print(f"raftlint: wrote {len(recs)} finding(s) to {baseline_path}")
+
+
+def _blame_age(path: Path, line: int) -> str:
+    """Committer date of a line via git blame, or '?' (untracked/no git)."""
+    rc, out = _git("blame", "-L", f"{line},{line}", "--porcelain",
+                   "--", str(path))
+    if rc != 0:
+        return "?"
+    for ln in out.splitlines():
+        if ln.startswith("committer-time "):
+            import datetime
+            ts = int(ln.split()[1])
+            return datetime.date.fromtimestamp(ts).isoformat()
+    return "?"
+
+
+def _list_suppressions(paths) -> int:
+    """Audit report of every ``# raftlint: disable[-file]=`` escape: rule,
+    file:line, age (git blame), and the comment text — deliberate escapes
+    stay reviewable as the count grows (LINT.md)."""
+    n = 0
+    for f in engine.iter_python_files(paths):
+        src = f.read_text(encoding="utf-8")
+        for lineno, kind, ids, text in engine.iter_suppressions(src):
+            n += 1
+            try:
+                rel = f.resolve().relative_to(REPO_ROOT)
+            except ValueError:
+                rel = f
+            print(f"{','.join(ids):<10} {rel}:{lineno}  "
+                  f"[{kind}, since {_blame_age(f, lineno)}]  {text}")
+    print(f"raftlint: {n} suppression(s)")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
-        prog="raftlint", description="JAX-hazard static analysis for raft-tpu")
+        prog="raftlint",
+        description="JAX + concurrency static analysis for raft-tpu")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/directories to scan (default: raft_tpu/)")
     p.add_argument("--strict", action="store_true",
@@ -62,6 +199,23 @@ def main(argv=None) -> int:
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("--contracts", action="store_true",
                    help="list every @contract'd signature instead of linting")
+    p.add_argument("--diff", nargs="?", const="HEAD", default=None,
+                   metavar="REV",
+                   help="scan only .py files changed vs REV (default HEAD) "
+                        "plus untracked ones — the fast pre-commit/CI "
+                        "incremental mode; applies the committed baseline")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help=f"findings baseline (default "
+                        f"{DEFAULT_BASELINE.name} in --diff mode): known "
+                        f"findings pass, new ones fail")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline (full-tree CI strictness)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline file and "
+                        "exit 0 (accepting them as known)")
+    p.add_argument("--list-suppressions", action="store_true",
+                   help="audit report of every '# raftlint: disable=' "
+                        "escape (rule, file:line, age via git blame)")
     args = p.parse_args(argv)
 
     if args.list_rules:
@@ -71,6 +225,18 @@ def main(argv=None) -> int:
     if args.contracts:
         _dump_contracts(paths)
         return 0
+    if args.list_suppressions:
+        return _list_suppressions(paths)
+    if args.diff is not None:
+        try:
+            paths = _changed_files(args.diff, paths)
+        except RuntimeError as e:
+            print(f"ERROR: {e}", file=sys.stderr)
+            return 2
+        if not paths:
+            print(f"raftlint: no .py files changed vs {args.diff}"
+                  + (" [strict]" if args.strict else ""))
+            return 0
     select = args.select.split(",") if args.select else None
     ignore = args.ignore.split(",") if args.ignore else None
     try:
@@ -78,6 +244,17 @@ def main(argv=None) -> int:
     except KeyError as e:
         print(f"ERROR: {e.args[0]}", file=sys.stderr)
         return 2
+
+    baseline = Path(args.baseline) if args.baseline else DEFAULT_BASELINE
+    if args.write_baseline:
+        _write_baseline(findings, baseline)
+        return 0
+    known = []
+    use_baseline = not args.no_baseline and (
+        args.baseline is not None
+        or (args.diff is not None and baseline.exists()))
+    if use_baseline:
+        findings, known = _apply_baseline(findings, baseline)
 
     if args.format == "json":
         print(json.dumps([f.__dict__ for f in findings], indent=2))
@@ -89,6 +266,7 @@ def main(argv=None) -> int:
         n_files = len(list(engine.iter_python_files(paths)))
         print(f"raftlint: {n_files} files scanned, {errors} error(s), "
               f"{warnings} warning(s)"
+              + (f", {len(known)} baselined" if known else "")
               + (" [strict]" if args.strict else ""))
     if args.strict and findings:
         return 1

@@ -617,6 +617,11 @@ def main() -> int:
         if args.chaos:
             robustness = dict(chaos=args.chaos, breaker_cooldown_s=2.0,
                               degraded_window_s=2.0)
+            # every fault storm doubles as a race hunt: arm the runtime
+            # lock-order validator (telemetry/watchdogs.py) before the
+            # server constructs its locks; the drill asserts zero
+            # violations after the storm (SERVING.md threading model)
+            os.environ.setdefault("RAFT_TPU_LOCK_WATCH", "1")
         sconfig = ServeConfig(
             buckets=parse_buckets(bucket_spec), max_batch=args.max_batch,
             max_wait_ms=args.max_wait_ms, queue_depth=args.queue_depth,
@@ -725,6 +730,16 @@ def main() -> int:
             prom.get("raft_batcher_restarts_total", 0))
         chaos_rec["nonfinite_outputs"] = int(
             prom.get("raft_nonfinite_outputs_total", 0))
+        # the race-hunt half of the drill: the lock-order validator was
+        # armed for the storm — violations must be zero and the families
+        # present (absence means the watch never armed: a dead assert)
+        lock_order = prom.get("raft_lock_order_violations_total")
+        chaos_rec["lock_order_violations"] = (
+            int(lock_order) if lock_order is not None else None)
+        chaos_rec["lock_hold_violations"] = int(
+            prom.get("raft_lock_hold_violations_total", 0))
+        chaos_rec["lock_holds_observed"] = int(
+            prom.get("raft_lock_hold_seconds_count", 0))
         rec["chaos"] = chaos_rec
     # provenance (OBSERVABILITY.md): every BENCH_serving.json record carries
     # the run manifest — git sha, jax versions, device, config hash — so the
@@ -750,6 +765,24 @@ def main() -> int:
         if rec["compile_misses_after_warmup"] != 0:
             problems.append(f"{rec['compile_misses_after_warmup']} "
                             f"compile(s) after warmup")
+        if chaos_rec is not None:
+            if chaos_rec["lock_order_violations"] is None:
+                problems.append("lock-order validator families missing "
+                                "from /metrics — RAFT_TPU_LOCK_WATCH "
+                                "never armed for the drill")
+            elif chaos_rec["lock_order_violations"] != 0:
+                problems.append(
+                    f"{chaos_rec['lock_order_violations']} lock-order "
+                    f"violation(s) under chaos (cycle/inversion/reentry "
+                    f"— see the server log)")
+            if chaos_rec["lock_hold_violations"]:
+                problems.append(
+                    f"{chaos_rec['lock_hold_violations']} lock hold(s) "
+                    f"over budget under chaos")
+            if chaos_rec["lock_order_violations"] == 0 \
+                    and not chaos_rec["lock_holds_observed"]:
+                problems.append("lock watch armed but observed zero lock "
+                                "holds — instrumentation dead?")
         if args.smoke and args.iters_policy and args.iters_policy != "fixed" \
                 and not args.url:
             # the adaptive-policy contract (in-process server only — an
