@@ -328,6 +328,28 @@ def _build_parser() -> argparse.ArgumentParser:
                    metavar="T",
                    help="serve mode: seconds the breaker stays open before "
                         "half-open probes test recovery")
+    # request-scoped tracing (telemetry/spans.py, OBSERVABILITY.md)
+    p.add_argument("--trace-sample", type=float, default=1.0, metavar="P",
+                   help="serve mode: fraction of completed request traces "
+                        "retained (flight recorder + run-log 'trace' "
+                        "events; error traces are always kept while > 0). "
+                        "0 disables request tracing entirely — no spans, "
+                        "no meta.timings, no SLO/flight-recorder families "
+                        "on /metrics")
+    p.add_argument("--slo-pair-ms", type=float, default=1000.0, metavar="T",
+                   help="serve mode: /v1/flow latency objective; slower "
+                        "(or failed) requests burn error budget — "
+                        "raft_slo_burn_rate{class=pair} on /metrics")
+    p.add_argument("--slo-stream-ms", type=float, default=500.0,
+                   metavar="T",
+                   help="serve mode: /v1/stream per-advance latency "
+                        "objective (class=stream burn rate)")
+    p.add_argument("--flightrec", default=None, metavar="PATH",
+                   help="serve mode: flight-recorder dump path — written "
+                        "on batcher crash, breaker open, post-warmup "
+                        "recompile, and shutdown/SIGTERM (default "
+                        "<--out>/flightrec.jsonl; '' disables the file, "
+                        "GET /debug/traces still serves the ring)")
     return p
 
 

@@ -56,6 +56,8 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from ..data.pipeline import unpad
+from ..telemetry import events as tlm_events
+from ..telemetry import spans as tlm_spans
 from .queue import DeadlineExceeded, RequestQueue
 
 
@@ -63,17 +65,20 @@ class PoisonedRequest(RuntimeError):
     """The bisected-guilty request of a failing batch: the engine fails
     whenever this request is present, after retries (HTTP 500, error
     class ``poisoned``)."""
+    trace_status = tlm_spans.POISONED
 
 
 class NonFiniteOutput(RuntimeError):
     """The engine produced NaN/Inf flow for this request's row (HTTP 500,
     error class ``poisoned``) — inputs were validated at the HTTP edge
     (http.py), so a non-finite *output* is an engine-side failure."""
+    trace_status = tlm_spans.POISONED
 
 
 class BatcherCrashed(RuntimeError):
     """The batcher thread died while this request was in flight; the
     supervisor restarts the loop — retry the request."""
+    trace_status = tlm_spans.ERROR
 
 
 class MicroBatcher:
@@ -136,9 +141,14 @@ class MicroBatcher:
             m.inc(*args)
 
     def _fail_expired(self, expired) -> None:
+        now = time.monotonic()
         for r in expired:
             self.timed_out += 1
             self._observe("requests", "timeout", 1)
+            if r.trace is not None:
+                # the whole life of an expired request WAS queue wait
+                r.trace.span("queue_wait", r.enqueued_at, now,
+                             status=tlm_spans.TIMEOUT)
             r.fail(DeadlineExceeded(
                 f"deadline exceeded after "
                 f"{time.monotonic() - r.enqueued_at:.3f}s in queue"))
@@ -147,7 +157,10 @@ class MicroBatcher:
         """One sessionful step (never coalesced: the queue keys stream
         requests per session).  Batch-size/occupancy histograms are left
         to pairwise batches — a stream step is definitionally batch 1 and
-        would only dilute the coalescing signal they exist to expose."""
+        would only dilute the coalescing signal they exist to expose; it
+        gets its own ``raft_stream_step_*`` families instead (batch 1,
+        occupancy 1.0 — the measured baseline ROADMAP item 1's continuous
+        stream batching has to beat)."""
         if self.stream_fn is None:
             r.fail(RuntimeError("stream request on a batcher without a "
                                 "stream executor"))
@@ -159,8 +172,13 @@ class MicroBatcher:
             r.fail(DeadlineExceeded(
                 f"stream step {r.id} abandoned by its handler"))
             return
+        tr = r.trace
+        if tr is not None:
+            tr.span("queue_wait", r.enqueued_at, r.dequeued_at)
+            tlm_spans.set_device_slot([])
         self._observe("inflight", 1)
         t0 = time.monotonic()
+        err, flow, iters_used = None, None, None
         try:
             flow, iters_used = self.stream_fn(r)
         except BaseException as e:
@@ -168,16 +186,33 @@ class MicroBatcher:
             # failing here is terminal for this frame.  Never swallow a
             # shutdown signal: fail the request, then let KeyboardInterrupt
             # / SystemExit keep propagating.
+            err = e
+        calls = tlm_spans.take_device_slot()
+        t1 = time.monotonic()
+        self._observe("inflight", -1)
+        self._observe("batch_latency", t1 - t0)
+        self._observe("stream_steps")
+        self._observe("stream_step_seconds", t1 - t0)
+        self._observe("stream_step_batch", 1.0)
+        self._observe("stream_step_occupancy", 1.0)
+        if tr is not None:
+            # spans BEFORE resolve/fail: the handler wakes on either and
+            # finishes the trace — a late span would hit a closed trace
+            eid = tr.span("execute", t0, t1,
+                          status=(tlm_spans.OK if err is None
+                                  else tlm_spans.status_of(err)),
+                          batch_real=1, batch_padded=1)
+            for kind, c0, c1, c2 in calls or ():
+                tr.span("execute_dispatch", c0, c1, parent=eid, call=kind)
+                tr.span("execute_block", c1, c2, parent=eid, call=kind)
+        if err is not None:
             if self.breaker is not None:
                 self.breaker.record(False)
             self._observe("requests", "error", 1)
-            r.fail(e)
-            if not isinstance(e, Exception):
-                raise
+            r.fail(err)
+            if not isinstance(err, Exception):
+                raise err
             return
-        finally:
-            self._observe("inflight", -1)
-            self._observe("batch_latency", time.monotonic() - t0)
         if self.breaker is not None:
             self.breaker.record(True)
         r.batch_real = r.batch_padded = 1
@@ -210,6 +245,9 @@ class MicroBatcher:
             return
         n = len(batch)
         padded = self.pad_batch_to(min(n, self.max_batch))
+        for r in batch:
+            if r.trace is not None:
+                r.trace.span("queue_wait", r.enqueued_at, r.dequeued_at)
         self._observe("batch_size", float(n))
         self._observe("batch_occupancy", n / padded)
         self._observe("inflight", 1)
@@ -221,17 +259,30 @@ class MicroBatcher:
             self._observe("inflight", -1)
             self._observe("batch_latency", time.monotonic() - t0)
 
-    def _run_group(self, group, budget) -> None:
+    def _run_group(self, group, budget, formed: bool = False) -> None:
         """Run one same-bucket group; on persistent engine failure, split
         and retry halves so only the guilty request(s) fail.  ``budget``
-        is the batch-wide engine-call allowance (mutable 1-list)."""
+        is the batch-wide engine-call allowance (mutable 1-list);
+        ``formed`` marks bisection sub-groups (the batch_form span is
+        recorded once, on the original group)."""
         n = len(group)
         padded = self.pad_batch_to(min(n, self.max_batch))
+        traced = [r for r in group if r.trace is not None]
+        t_form1 = time.monotonic()
+        if not formed:
+            for r in traced:
+                r.trace.span("batch_form", r.dequeued_at, t_form1, group=n)
         im1 = np.concatenate([r.image1 for r in group]
                              + [group[-1].image1] * (padded - n))
         im2 = np.concatenate([r.image2 for r in group]
                              + [group[-1].image2] * (padded - n))
+        t_pad1 = time.monotonic()
+        for r in traced:
+            r.trace.span("pad", t_form1, t_pad1, padded=padded)
         out, err, attempts = None, None, 0
+        t_exec0 = time.monotonic()
+        if traced:
+            tlm_spans.set_device_slot([])
         while attempts <= self.retries and budget[0] > 0:
             attempts += 1
             budget[0] -= 1
@@ -249,15 +300,49 @@ class MicroBatcher:
             except BaseException as e:
                 # shutdown (KeyboardInterrupt/SystemExit): fail the group
                 # so no handler hangs, then keep propagating — swallowing
-                # it here would eat Ctrl-C
+                # it here would eat Ctrl-C.  Same type per waiter, but a
+                # FRESH instance each: the HTTP layer stamps the
+                # request's trace id onto the exception it receives
+                t_x = time.monotonic()
+                tlm_spans.take_device_slot()
+                sid = tlm_spans.new_span_id()
                 for r in group:
+                    if r.trace is not None:
+                        r.trace.span("execute", t_exec0, t_x,
+                                     status=tlm_spans.ERROR, span_id=sid,
+                                     batch_real=n, batch_padded=padded)
                     self._observe("requests", "error", 1)
-                    r.fail(e)
+                    try:
+                        fresh = type(e)(*e.args)
+                    except Exception:
+                        # constructor rejects its own args (kwarg-only
+                        # shutdown wrappers): the shared instance is still
+                        # a correct failure — stamp-if-absent keeps the
+                        # first trace id
+                        fresh = e
+                    r.fail(fresh)
                 raise
             if self.breaker is not None:
                 self.breaker.record(True)
             err = None
             break
+        calls = tlm_spans.take_device_slot() if traced else ()
+        t_exec1 = time.monotonic()
+        # co-batched requests SHARE one execute span id (the join key
+        # across their traces); each trace holds its own copy with its
+        # own queue spans around it
+        exec_sid = tlm_spans.new_span_id()
+
+        def _exec_span(tr, status):
+            tr.span("execute", t_exec0, t_exec1, status=status,
+                    span_id=exec_sid, batch_real=n, batch_padded=padded,
+                    attempts=attempts)
+            for kind, c0, c1, c2 in calls or ():
+                tr.span("execute_dispatch", c0, c1, parent=exec_sid,
+                        call=kind)
+                tr.span("execute_block", c1, c2, parent=exec_sid,
+                        call=kind)
+
         if out is None and err is None:
             # budget ran dry before this sub-group got a single attempt
             err = RuntimeError("bisection budget exhausted before this "
@@ -266,6 +351,8 @@ class MicroBatcher:
             if n == 1 and attempts:
                 # bisected down to the guilty request: the 'poisoned'
                 # error class — co-batched neighbors already succeeded
+                if group[0].trace is not None:
+                    _exec_span(group[0].trace, tlm_spans.POISONED)
                 self._observe("requests", "poisoned", 1)
                 group[0].fail(PoisonedRequest(
                     f"request {group[0].id} poisons its batch: engine "
@@ -274,14 +361,26 @@ class MicroBatcher:
             if budget[0] <= 0:
                 # retry budget exhausted mid-bisection: the engine is
                 # sick, not one request — fail the remainder as plain
-                # errors (the breaker is already counting these)
+                # errors (the breaker is already counting these).  Each
+                # request gets its OWN exception instance: the HTTP
+                # layer stamps the request's trace id onto it, and a
+                # shared instance would cross-wire ids between
+                # co-batched clients
                 for r in group:
+                    if r.trace is not None:
+                        _exec_span(r.trace, tlm_spans.ERROR)
                     self._observe("requests", "error", 1)
-                    r.fail(err)
+                    r.fail(RuntimeError(
+                        f"engine failing across requests (retry budget "
+                        f"exhausted): {err}"))
                 return
+            # the failed attempt stays visible in every trace (status
+            # "retry"); the sub-groups record their own execute spans
+            for r in traced:
+                _exec_span(r.trace, "retry")
             mid = n // 2
-            self._run_group(group[:mid], budget)
-            self._run_group(group[mid:], budget)
+            self._run_group(group[:mid], budget, formed=True)
+            self._run_group(group[mid:], budget, formed=True)
             return
         # converge-policy engines return (flows, per-row iters_used); only
         # REAL rows are accounted — padding rows repeat the last request
@@ -305,13 +404,24 @@ class MicroBatcher:
             self._observe("queue_latency", r.dequeued_at - r.enqueued_at)
             self._observe("request_latency", now - r.enqueued_at)
             if row_ok[i]:
+                if r.trace is not None:
+                    _exec_span(r.trace, tlm_spans.OK)
                 self._observe("requests", "ok", 1)
                 self.served += 1
                 served += 1
                 r.resolve(unpad(flows[i:i + 1], r.pads)[0])
             else:
+                if r.trace is not None:
+                    _exec_span(r.trace, tlm_spans.POISONED)
                 self._observe("nonfinite")
                 self._observe("requests", "poisoned", 1)
+                log = tlm_events.current()
+                if log is not None:
+                    # joinable to the request trace (chaos drills): the
+                    # sentinel's run-log record carries the trace id
+                    log.event("nonfinite_output", request=r.id,
+                              trace_id=(r.trace.trace_id
+                                        if r.trace is not None else None))
                 r.fail(NonFiniteOutput(
                     f"non-finite flow output for request {r.id} "
                     f"(poisoned row in an otherwise-healthy batch)"))
@@ -333,9 +443,19 @@ class MicroBatcher:
                 # here must leave the batch visible to _thread_main's
                 # crash handler (it fails whatever is not yet done)
                 self._inflight_batch = batch
-                if self.faults is not None:
-                    self.faults.maybe_kill()       # chaos: thread-death arm
-                self._execute(batch)
+                # ambient trace ids for this batch: out-of-band
+                # diagnostics fired from under here (fault_injected,
+                # lock_violation, the non-finite sentinel) become
+                # joinable to the request traces they hit
+                tlm_spans.set_current_trace_ids(tuple(
+                    r.trace.trace_id for r in batch
+                    if r.trace is not None))
+                try:
+                    if self.faults is not None:
+                        self.faults.maybe_kill()   # chaos: thread-death arm
+                    self._execute(batch)
+                finally:
+                    tlm_spans.set_current_trace_ids(())
                 self._inflight_batch = None
 
     def _thread_main(self) -> None:
@@ -349,6 +469,13 @@ class MicroBatcher:
             for r in (self._inflight_batch or []):
                 if not r.done:
                     self._observe("requests", "error", 1)
+                    if r.trace is not None:
+                        # finish (idempotent) BEFORE failing: the
+                        # supervisor dumps the flight recorder on this
+                        # thread right after, and the crashed trace must
+                        # already be in the ring — the woken handler's
+                        # own finish becomes a no-op
+                        r.trace.finish(tlm_spans.ERROR)
                     r.fail(BatcherCrashed(
                         f"batcher thread died mid-batch ({e!r}); "
                         f"the supervisor restarts it — retry"))

@@ -116,6 +116,28 @@ class ServeConfig:
     breaker_threshold: float = 0.5
     breaker_min_volume: int = 8
     breaker_cooldown_s: float = 5.0
+    # Request-scoped tracing (telemetry/spans.py, OBSERVABILITY.md):
+    # fraction of completed request traces RETAINED (flight recorder +
+    # run-log `trace` events) — error-status traces are always retained
+    # while tracing is on.  Every request still records spans (the
+    # response's meta.timings), retention is what's sampled.  0 disables
+    # tracing outright: no spans, no meta.timings, no SLO/flight-recorder
+    # machinery, and /metrics gains none of their families.
+    trace_sample: float = 1.0
+    # Per-class latency objectives (SLO): a completed request slower than
+    # its class objective — or terminating non-ok — burns error budget.
+    # raft_slo_burn_rate{class=} = violating fraction of the last
+    # `slo_window` requests / `slo_budget`; >> 1 means this replica
+    # cannot meet its objective (the autoscaling signal, ROADMAP item 3).
+    slo_pair_ms: float = 1000.0
+    slo_stream_ms: float = 500.0
+    slo_budget: float = 0.01
+    slo_window: int = 256
+    # Flight recorder: ring capacity (last N ok traces + up to N error
+    # traces) and the auto-dump path (batcher crash / breaker open /
+    # recompile watchdog / shutdown; None = /debug/traces only).
+    flightrec_traces: int = 64
+    flightrec_path: Optional[str] = None
     # Engine-failure containment (batcher): same-group retries (with
     # backoff) before poisoned-batch bisection splits the blame.
     engine_retries: int = 1
@@ -160,6 +182,19 @@ class ServeConfig:
         if self.breaker_window and not self.breaker_cooldown_s > 0:
             raise ValueError(f"breaker_cooldown_s must be > 0, "
                              f"got {self.breaker_cooldown_s}")
+        if not 0.0 <= self.trace_sample <= 1.0:
+            raise ValueError(f"trace_sample must be in [0, 1] (0 disables "
+                             f"tracing), got {self.trace_sample}")
+        if self.trace_sample > 0:
+            if self.slo_pair_ms <= 0 or self.slo_stream_ms <= 0:
+                raise ValueError("slo_pair_ms and slo_stream_ms must be "
+                                 "> 0 while tracing is on")
+            if not 0.0 < self.slo_budget <= 1.0:
+                raise ValueError(f"slo_budget must be in (0, 1], "
+                                 f"got {self.slo_budget}")
+            if self.slo_window < 1 or self.flightrec_traces < 1:
+                raise ValueError("slo_window and flightrec_traces must "
+                                 "be >= 1")
         if self.engine_retries < 0:
             raise ValueError(f"engine_retries must be >= 0, "
                              f"got {self.engine_retries}")

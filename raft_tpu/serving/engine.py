@@ -24,6 +24,7 @@ import numpy as np
 
 from ..config import RAFTConfig, adaptive_iters
 from ..lint.concurrency import guarded_by
+from ..telemetry import spans as tlm_spans
 from ..telemetry.log import get_logger
 from ..telemetry.watchdogs import watched_lock
 from .config import ServeConfig
@@ -240,14 +241,23 @@ class InferenceEngine:
             self.pair_calls += 1
         if self.faults is not None:
             self.faults.pre_engine_call()
+        # dispatch vs block-until-ready, timed at the only place that can
+        # tell them apart: the executable call returns as soon as the work
+        # is enqueued (async dispatch — wall clock at the call site lies),
+        # np.asarray is what actually waits for the device
+        t0 = time.monotonic()
         out = ex(self.params, im1, im2)
+        t1 = time.monotonic()
         if self.adaptive:
             flow, iters_used = out
             flow = np.asarray(flow)
+            iters_used = np.asarray(iters_used)
+            tlm_spans.record_device_call("pair", t0, t1, time.monotonic())
             if self.faults is not None:
                 flow = self.faults.corrupt_rows(flow)
-            return flow, np.asarray(iters_used)
+            return flow, iters_used
         flow = np.asarray(out)
+        tlm_spans.record_device_call("pair", t0, t1, time.monotonic())
         if self.faults is not None:
             flow = self.faults.corrupt_rows(flow)
         return flow
@@ -263,7 +273,13 @@ class InferenceEngine:
             self.encode_calls += 1
         if self.faults is not None:
             self.faults.pre_engine_call()
-        return ex(self.params, image)
+        t0 = time.monotonic()
+        out = ex(self.params, image)
+        t1 = time.monotonic()
+        # outputs stay device-resident (they are the session cache), so
+        # there is no block-until-ready here — dispatch only
+        tlm_spans.record_device_call("encode", t0, t1, t1)
+        return out
 
     def run_stream(self, bucket: Tuple[int, int], image: np.ndarray,
                    fmap_prev, cnet_prev, flow_init: np.ndarray):
@@ -278,7 +294,9 @@ class InferenceEngine:
             self.stream_calls += 1
         if self.faults is not None:
             self.faults.pre_engine_call()
+        t0 = time.monotonic()
         out = ex(self.params, image, fmap_prev, cnet_prev, flow_init)
+        t1 = time.monotonic()
         if self.adaptive:
             flow, flow_lr, fmap, cnet, iters_used = out
             iters_used = np.asarray(iters_used)
@@ -286,6 +304,8 @@ class InferenceEngine:
             flow, flow_lr, fmap, cnet = out
             iters_used = None
         flow = np.asarray(flow)
+        flow_lr = np.asarray(flow_lr)
+        tlm_spans.record_device_call("stream", t0, t1, time.monotonic())
         if self.faults is not None:
             flow = self.faults.corrupt_rows(flow)
-        return flow, np.asarray(flow_lr), fmap, cnet, iters_used
+        return flow, flow_lr, fmap, cnet, iters_used

@@ -44,6 +44,7 @@ import numpy as np
 
 from ..lint.concurrency import guarded_by
 from ..telemetry.log import get_logger
+from ..telemetry.spans import current_trace_ids
 from ..telemetry.watchdogs import watched_lock
 
 _log = get_logger("serve")
@@ -192,7 +193,12 @@ class FaultInjector:
             if self.counter is not None:
                 self.counter.labels(arm).inc()
             if self.run_log is not None:
-                self.run_log.event("fault_injected", arm=arm)
+                # the batch's trace ids ride along (telemetry/spans.py
+                # ambient), so a drill's fault_injected events join to
+                # the request traces they poisoned
+                ids = current_trace_ids()
+                self.run_log.event("fault_injected", arm=arm,
+                                   trace_ids=list(ids) if ids else None)
             _log.warning(f"chaos: injecting fault arm={arm}")
         return hit
 

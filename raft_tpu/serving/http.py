@@ -2,10 +2,22 @@
 
 Endpoints:
 
-  POST /v1/flow    infer optical flow for one image pair
-  POST /v1/stream  sessionful video flow: open / advance / close
-  GET  /healthz    liveness/readiness (503 while draining)
-  GET  /metrics    Prometheus text exposition
+  POST /v1/flow       infer optical flow for one image pair
+  POST /v1/stream     sessionful video flow: open / advance / close
+  GET  /healthz       liveness/readiness (503 while draining)
+  GET  /metrics       Prometheus text exposition
+  GET  /debug/traces  flight-recorder view: recent + error request traces
+                      (optionally ?trace_id=<prefix>; 404 when tracing is
+                      off via --trace-sample 0)
+
+Request tracing (OBSERVABILITY.md): every traced request carries a
+``trace_id`` — minted server-side, or adopted from an ``X-Raft-Trace-Id``
+request header — returned in the response (``meta.trace_id`` + the
+``X-Raft-Trace-Id`` header) along with the server-side latency breakdown:
+``meta.timings`` / the ``X-Raft-Timings`` header, per-span milliseconds
+(admit, queue_wait, batch_form, pad, execute, execute_dispatch,
+execute_block).  Error responses carry the trace id too when the request
+got far enough to mint one.
 
 ``/v1/flow`` accepts two encodings:
 
@@ -41,13 +53,17 @@ only deepens the storm.  Every terminal status increments
 
 from __future__ import annotations
 
+import contextlib
 import io
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 import numpy as np
 
+from ..telemetry import spans as tlm_spans
 from ..telemetry.log import get_logger
 from .queue import RejectedError
 
@@ -57,7 +73,9 @@ MAX_BODY_BYTES = 256 * 2**20   # one 4K pair is ~100 MB as float32 JSON
 
 
 class BadRequest(Exception):
-    pass
+    # the client's mistake, not the replica's: no SLO burn, no seat in
+    # the error-trace ring (telemetry/spans.py status taxonomy)
+    trace_status = tlm_spans.BAD_REQUEST
 
 
 def _decode_image(obj, name: str) -> np.ndarray:
@@ -170,6 +188,29 @@ def parse_stream_request(body: bytes, content_type: str):
     return op, sid, image, dl
 
 
+@contextlib.contextmanager
+def _traced_send(tr, t_resp0: float):
+    """One definition of stamping a trace onto a 200 response (both
+    endpoints, both encodings): yields ``(headers, timings)`` — the
+    X-Raft-* response headers and the per-span milliseconds for
+    ``meta.timings`` (both None untraced) — and on exit, even if the
+    client disconnected mid-write, records the respond span from
+    ``t_resp0`` and finishes the trace so it cannot leak open."""
+    headers = timings = None
+    if tr is not None:
+        # timings snapshot BEFORE the respond span lands: the span is
+        # still being written while the body goes out
+        timings = tr.timings_ms()
+        headers = {"X-Raft-Trace-Id": tr.trace_id,
+                   "X-Raft-Timings": json.dumps(timings)}
+    try:
+        yield headers, timings
+    finally:
+        if tr is not None:
+            tr.span("respond", t_resp0, time.monotonic())
+            tr.finish()
+
+
 class _Handler(BaseHTTPRequestHandler):
     # the FlowServer instance; set on the subclass by make_http_server
     server_app = None
@@ -199,12 +240,30 @@ class _Handler(BaseHTTPRequestHandler):
     def _send_rejection(self, e) -> None:
         """RejectedError -> its HTTP status; 429/503 advertise
         ``Retry-After`` (whole seconds, >= 1) so clients back off
-        instead of retrying into the shed."""
-        headers = None
+        instead of retrying into the shed.  A rejection that got as far
+        as minting a trace carries its id back (the exception's
+        ``trace_id``, stamped where the trace was closed)."""
+        headers = {}
+        body = {"error": str(e)}
         retry_after = getattr(e, "retry_after", None)
         if retry_after is not None:
-            headers = {"Retry-After": str(max(1, int(-(-retry_after // 1))))}
-        self._send_json(e.http_status, {"error": str(e)}, headers=headers)
+            headers["Retry-After"] = str(max(1, int(-(-retry_after // 1))))
+        tid = getattr(e, "trace_id", None)
+        if tid is not None:
+            headers["X-Raft-Trace-Id"] = tid
+            body["trace_id"] = tid
+        self._send_json(e.http_status, body, headers=headers or None)
+
+    def _send_error(self, status: int, message: str, e) -> None:
+        """400/500 twin of :meth:`_send_rejection`: one definition of
+        'stamp the trace id onto an error response' (body + header)."""
+        body = {"error": message}
+        headers = None
+        tid = getattr(e, "trace_id", None)
+        if tid is not None:
+            body["trace_id"] = tid
+            headers = {"X-Raft-Trace-Id": tid}
+        self._send_json(status, body, headers=headers)
 
     # -- endpoints --------------------------------------------------------
 
@@ -232,6 +291,11 @@ class _Handler(BaseHTTPRequestHandler):
                 if app.breaker is not None:
                     health["breaker"] = {"state": app.breaker.state,
                                          "opens": app.breaker.opens}
+                if app.flightrec is not None:
+                    health["tracing"] = {
+                        "sample": app.sconfig.trace_sample,
+                        "open_traces": app.tracer.open_traces,
+                    }
                 streams = getattr(app, "streams", None)
                 if streams is not None:
                     health["stream"] = {
@@ -244,6 +308,29 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/metrics":
             self._send(200, app.registry.render().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/debug/traces":
+            # on-demand flight-recorder view: recent ok traces + all
+            # retained error traces, optionally ?trace_id=<prefix>
+            if app.flightrec is None:
+                self._send_json(404, {"error": "tracing disabled "
+                                      "(--trace-sample 0)"})
+                return
+            qs = parse_qs(self.path.partition("?")[2])
+            traces = app.flightrec.snapshot()
+            want = (qs.get("trace_id") or [None])[0]
+            if want:
+                # stored ids are lowercase (spans.clean_trace_id); match
+                # the exact header value a client sent, any case
+                want = want.lower()
+                traces = [t for t in traces
+                          if t.get("trace_id", "").startswith(want)]
+            ring, errors = app.flightrec.counts()
+            self._send_json(200, {
+                "open_traces": app.tracer.open_traces,
+                "finished": app.tracer.finished,
+                "retained_ok": ring, "retained_error": errors,
+                "dumps": app.flightrec.dumps,
+                "traces": traces})
         else:
             self._send_json(404, {"error": f"no handler for {path}"})
 
@@ -281,21 +368,23 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": str(e)})
             return
         try:
-            req = app.infer(im1, im2, deadline_ms)
+            req = app.infer(im1, im2, deadline_ms,
+                            trace_id=self.headers.get("X-Raft-Trace-Id"),
+                            finish_trace=False)
         except RejectedError as e:
             # rejected/timeout accounting happens where the decision is
             # made (submit / batcher purge / wait timeout / breaker);
-            # just translate to HTTP (+ Retry-After) here
+            # just translate to HTTP (+ Retry-After + trace id) here
             self._send_rejection(e)
             return
         except BadRequest as e:
             app.count_request("bad_request")
-            self._send_json(400, {"error": str(e)})
+            self._send_error(400, str(e), e)
             return
         except Exception as e:
             # engine/batcher failure (already counted status="error" where
             # the batch died): a proper 500, not a dropped socket
-            self._send_json(500, {"error": f"inference failed: {e}"})
+            self._send_error(500, f"inference failed: {e}", e)
             return
         meta = {
             "bucket": list(req.bucket),
@@ -304,13 +393,25 @@ class _Handler(BaseHTTPRequestHandler):
         }
         if req.iters_used is not None:     # converge policy: compute spent
             meta["iters_used"] = req.iters_used
-        if "application/octet-stream" in (self.headers.get("Accept") or ""):
-            buf = io.BytesIO()
-            np.savez(buf, flow=req.result,
-                     bucket=np.asarray(req.bucket, np.int32))
-            self._send(200, buf.getvalue(), "application/octet-stream")
-        else:
-            self._send_json(200, {"flow": req.result.tolist(), "meta": meta})
+        tr = req.trace
+        # the respond span starts when the batcher resolved the request:
+        # event-wake + marshal + socket write are all response delivery
+        t_resp0 = req.finished_at or time.monotonic()
+        with _traced_send(tr, t_resp0) as (headers, timings):
+            if timings is not None:
+                # meta.timings (SERVING.md); npz clients read the header
+                meta["trace_id"] = tr.trace_id
+                meta["timings"] = timings
+            if "application/octet-stream" in (self.headers.get("Accept")
+                                              or ""):
+                buf = io.BytesIO()
+                np.savez(buf, flow=req.result,
+                         bucket=np.asarray(req.bucket, np.int32))
+                self._send(200, buf.getvalue(), "application/octet-stream",
+                           headers=headers)
+            else:
+                self._send_json(200, {"flow": req.result.tolist(),
+                                      "meta": meta}, headers=headers)
 
     def _post_stream(self):
         app = self.server_app
@@ -325,38 +426,51 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": str(e)})
             return
         try:
-            res = app.stream_call(op, sid, image, deadline_ms)
+            res = app.stream_call(op, sid, image, deadline_ms,
+                                  trace_id=self.headers.get(
+                                      "X-Raft-Trace-Id"),
+                                  finish_trace=False)
         except RejectedError as e:
             # includes UnknownSession (404) and SessionBusy (409) — the
-            # status (and any Retry-After) rides on the exception
+            # status (and any Retry-After + trace id) rides the exception
             self._send_rejection(e)
             return
         except BadRequest as e:
             app.count_request("bad_request")
-            self._send_json(400, {"error": str(e)})
+            self._send_error(400, str(e), e)
             return
         except Exception as e:
-            self._send_json(500, {"error": f"inference failed: {e}"})
+            self._send_error(500, f"inference failed: {e}", e)
             return
+        tr = res.pop("_trace", None)
+        t_resp0 = res.pop("_finished_at", None) or time.monotonic()
         flow = res.pop("flow", None)
-        if "application/octet-stream" in (self.headers.get("Accept") or ""):
-            buf = io.BytesIO()
-            arrays = {"session": np.asarray(res["session"]),
-                      "frame": np.asarray(res.get("frame", 0), np.int32)}
-            if flow is not None:
-                arrays["flow"] = flow
-            meta = res.get("meta") or {}
-            if "warm" in meta:
-                arrays["warm"] = np.asarray(meta["warm"])
-            if "iters_used" in meta:
-                arrays["iters_used"] = np.asarray(meta["iters_used"],
-                                                  np.int32)
-            np.savez(buf, **arrays)
-            self._send(200, buf.getvalue(), "application/octet-stream")
-        else:
-            if flow is not None:
-                res["flow"] = flow.tolist()
-            self._send_json(200, res)
+        with _traced_send(tr, t_resp0) as (headers, timings):
+            if timings is not None:
+                meta = res.get("meta")
+                if meta is not None:
+                    meta["timings"] = timings
+            if "application/octet-stream" in (self.headers.get("Accept")
+                                              or ""):
+                buf = io.BytesIO()
+                arrays = {"session": np.asarray(res["session"]),
+                          "frame": np.asarray(res.get("frame", 0),
+                                              np.int32)}
+                if flow is not None:
+                    arrays["flow"] = flow
+                meta = res.get("meta") or {}
+                if "warm" in meta:
+                    arrays["warm"] = np.asarray(meta["warm"])
+                if "iters_used" in meta:
+                    arrays["iters_used"] = np.asarray(meta["iters_used"],
+                                                      np.int32)
+                np.savez(buf, **arrays)
+                self._send(200, buf.getvalue(), "application/octet-stream",
+                           headers=headers)
+            else:
+                if flow is not None:
+                    res["flow"] = flow.tolist()
+                self._send_json(200, res, headers=headers)
 
 
 def make_http_server(app, host: str, port: int) -> ThreadingHTTPServer:

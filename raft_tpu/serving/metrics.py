@@ -13,6 +13,7 @@ pre-refactor renderer.
 
 from __future__ import annotations
 
+import functools
 from typing import Dict
 
 from ..telemetry.registry import (_Metric,  # noqa: F401 — compat re-export
@@ -125,9 +126,56 @@ def make_stream_metrics(registry: Registry, store) -> Dict[str, _Metric]:
             "Stream advances whose warm step faulted (engine error or "
             "non-finite output) and were transparently retried through "
             "the cold-restart path"),
+        # the stream-path occupancy gap (ROADMAP item 1): stream steps
+        # execute per session outside the pairwise batch histograms, so
+        # they get their own families — the measured baseline (batch 1,
+        # occupancy 1.0 today) continuous stream batching has to beat
+        "steps": registry.counter(
+            "raft_stream_steps_total",
+            "Stream device steps executed (session opens + advances — "
+            "each one device call today)"),
+        "step_seconds": registry.histogram(
+            "raft_stream_step_seconds",
+            "Device time per stream step (the per-session serialization "
+            "ROADMAP item 1's continuous stream batching attacks)"),
+        "step_batch": registry.histogram(
+            "raft_stream_step_batch",
+            "Sessions coalesced per stream device step (1 until stream "
+            "steps batch across sessions)",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0)),
+        "step_occupancy": registry.histogram(
+            "raft_stream_step_occupancy",
+            "Real sessions / padded slots per stream device step (the "
+            "stream twin of raft_serving_batch_occupancy)",
+            buckets=tuple(i / 10 for i in range(1, 11))),
     }
     store.evictions = m["evictions"]
     return m
+
+
+def make_slo_metrics(registry: Registry, slo) -> Dict[str, _Metric]:
+    """SLO burn-rate families over the span data (telemetry/spans.py
+    SLOTracker).  Registered only while tracing is on (trace_sample > 0)
+    so `--trace-sample 0` keeps the /metrics exposition free of tracing
+    families.  The violation counter is handed back to the tracker (the
+    decision-site labeling pattern the session store uses)."""
+    burn = registry.gauge(
+        "raft_slo_burn_rate",
+        "Error-budget burn rate per request class: violating fraction of "
+        "the SLO window / slo_budget (1 = burning exactly the budget, "
+        ">> 1 = this replica cannot meet its latency objective)",
+        labelnames=("class",))
+    for cls in sorted(slo.objectives):
+        burn.labels(cls).set_fn(functools.partial(slo.burn_rate, cls))
+    violations = registry.counter(
+        "raft_slo_violations_total",
+        "Requests that burned error budget, by class (slower than the "
+        "class objective, or terminated shed/timeout/poisoned/error)",
+        labelnames=("class",))
+    for cls in sorted(slo.objectives):
+        violations.labels(cls)        # pre-create: exposition shows 0
+    slo.violations = violations
+    return {"burn_rate": burn, "violations": violations}
 
 
 def make_robustness_metrics(registry: Registry,

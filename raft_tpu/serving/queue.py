@@ -29,9 +29,12 @@ class RejectedError(Exception):
     """Base: request refused before reaching the device.  ``retry_after``
     (seconds, None = don't advertise) rides to the HTTP layer as a
     ``Retry-After`` header on 429/503 responses — the docstrings always
-    promised "retry with backoff"; now the wire says when."""
+    promised "retry with backoff"; now the wire says when.
+    ``trace_status`` is the request-trace disposition this rejection maps
+    to (telemetry/spans.py status taxonomy)."""
     http_status = 500
     retry_after: Optional[float] = None
+    trace_status = "shed"
 
 
 class QueueFull(RejectedError):
@@ -49,6 +52,7 @@ class Draining(RejectedError):
 class DeadlineExceeded(RejectedError):
     """Deadline passed while the request waited (504)."""
     http_status = 504
+    trace_status = "timeout"
 
 
 _ids = itertools.count(1)
@@ -60,8 +64,9 @@ class Request:
     ``fail``."""
 
     __slots__ = ("id", "image1", "image2", "bucket", "pads", "deadline",
-                 "enqueued_at", "dequeued_at", "_done", "result", "error",
-                 "batch_real", "batch_padded", "iters_used")
+                 "enqueued_at", "dequeued_at", "finished_at", "_done",
+                 "result", "error", "batch_real", "batch_padded",
+                 "iters_used", "trace")
 
     def __init__(self, image1: np.ndarray, image2: np.ndarray,
                  bucket: Tuple[int, int], pads: Tuple[int, int, int, int],
@@ -74,6 +79,10 @@ class Request:
         self.deadline = deadline      # monotonic seconds
         self.enqueued_at = time.monotonic()
         self.dequeued_at: Optional[float] = None
+        # stamped at resolve/fail: the respond span starts here, so the
+        # event-wake gap (resolve -> handler thread scheduled) is
+        # attributed to response delivery, not lost
+        self.finished_at: Optional[float] = None
         self._done = threading.Event()
         self.result: Optional[np.ndarray] = None   # unpadded [h, w, 2]
         self.error: Optional[BaseException] = None
@@ -82,6 +91,9 @@ class Request:
         # GRU iterations this request's sample actually spent (set by the
         # batcher under --iters-policy converge:*; None under 'fixed')
         self.iters_used: Optional[int] = None
+        # request-scoped trace (telemetry.spans.RequestTrace) attached by
+        # the server at admission; None when tracing is sampled out
+        self.trace = None
 
     @property
     def done(self) -> bool:
@@ -90,10 +102,12 @@ class Request:
 
     def resolve(self, flow: np.ndarray) -> None:
         self.result = flow
+        self.finished_at = time.monotonic()
         self._done.set()
 
     def fail(self, err: BaseException) -> None:
         self.error = err
+        self.finished_at = time.monotonic()
         self._done.set()
 
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:

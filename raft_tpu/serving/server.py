@@ -28,6 +28,7 @@ from ..config import RAFTConfig
 from ..data.pipeline import pad_to_shape
 from ..lint.concurrency import SERVING_LOCK_HIERARCHY
 from ..telemetry import events as tlm_events
+from ..telemetry import spans as tlm_spans
 from ..telemetry import watchdogs as tlm_watchdogs
 from ..telemetry.log import get_logger
 from ..telemetry.trace import TraceWindow, stage
@@ -38,7 +39,8 @@ from .engine import InferenceEngine
 from .faults import make_injector
 from .http import BadRequest, make_http_server, serve_in_thread
 from .metrics import (Registry, make_fault_metrics, make_robustness_metrics,
-                      make_serving_metrics, make_stream_metrics)
+                      make_serving_metrics, make_slo_metrics,
+                      make_stream_metrics)
 from .queue import DeadlineExceeded, Draining, Request, RequestQueue
 from .session import SessionStore
 from .stream import StreamCoordinator
@@ -81,6 +83,10 @@ class BatcherSupervisor:
             self.counter.inc()
         _log.error(f"batcher thread crashed ({exc!r}); restart "
                    f"#{self.restarts}")
+        # the crash is exactly what the flight recorder exists for: leave
+        # the last N traces + every error trace as an artifact before the
+        # restart muddies the water
+        self.server._flight_dump("batcher_crash")
         if self.server.draining:
             self._fail_drained(exc)       # shutting down: no restart, but
             return                        # queued work must not hang
@@ -154,18 +160,41 @@ class FlowServer:
         self._robustness = make_robustness_metrics(self.registry,
                                                    breaker=self.breaker)
         self.metrics["nonfinite"] = self._robustness["nonfinite"]
+        # request-scoped tracing (telemetry/spans.py): tracer + flight
+        # recorder + SLO burn accounting.  trace_sample 0 disables the
+        # whole plane — requests carry trace=None, every hook is one
+        # `is not None`, and /metrics gains none of these families.
+        self.flightrec = None
+        self.slo = None
+        if sconfig.trace_sample > 0:
+            self.flightrec = tlm_spans.FlightRecorder(
+                capacity=sconfig.flightrec_traces,
+                path=sconfig.flightrec_path)
+            self.slo = tlm_spans.SLOTracker(
+                objectives={"pair": sconfig.slo_pair_ms / 1000.0,
+                            "stream": sconfig.slo_stream_ms / 1000.0},
+                budget=sconfig.slo_budget, window=sconfig.slo_window)
+            make_slo_metrics(self.registry, self.slo)
+        self.tracer = tlm_spans.Tracer(sample=sconfig.trace_sample,
+                                       recorder=self.flightrec,
+                                       slo=self.slo)
         # streaming (/v1/stream): a bounded session store + coordinator,
         # built only when declared (--max-sessions > 0) so a pairwise-only
         # server keeps its exact warmup grid and /metrics exposition
         self.streams = None
         if sconfig.max_sessions > 0:
             store = SessionStore(sconfig.max_sessions, sconfig.session_ttl_s)
+            stream_metrics = make_stream_metrics(self.registry, store)
             self.streams = StreamCoordinator(
-                store, sconfig, self.queue,
-                make_stream_metrics(self.registry, store),
+                store, sconfig, self.queue, stream_metrics,
                 self.count_request, faults=self.faults,
                 nonfinite=self._robustness["nonfinite"],
-                breaker=self.breaker)
+                breaker=self.breaker, tracer=self.tracer)
+            # the stream-step families are observed by the batcher (the
+            # thread that owns the device), so they ride its metrics dict
+            for k in ("steps", "step_seconds", "step_batch",
+                      "step_occupancy"):
+                self.metrics[f"stream_{k}"] = stream_metrics[k]
         # engine injection: tests drive the batching policy with stubs
         self.engine = engine if engine is not None else InferenceEngine(
             config, params, sconfig, iters=iters,
@@ -234,12 +263,33 @@ class FlowServer:
     def _breaker_opened(self) -> None:
         """Breaker open: demote every streaming session's device features
         so nothing cached before the storm is trusted after it — their
-        next advance takes the transparent cold-restart path."""
+        next advance takes the transparent cold-restart path.
+
+        Runs under the breaker's lock (the declared breaker -> store
+        hierarchy edge), so the flight-recorder dump — file I/O — is
+        handed to a short-lived thread: handlers blocked in
+        ``breaker.allow()`` must not wait on a disk write, and a slow
+        dump must not trip the watched-lock hold budget."""
         if self.streams is not None:
             n = self.streams.store.demote_all()
             if n:
                 _log.warning(f"breaker open: demoted {n} streaming "
                              f"session(s) to the cold-restart path")
+        threading.Thread(target=self._flight_dump, args=("breaker_open",),
+                         daemon=True, name="raft-flightrec-dump").start()
+
+    def _flight_dump(self, reason: str) -> None:
+        """Write the flight-recorder rings to their configured path (no-op
+        without one — /debug/traces still serves the in-memory view)."""
+        if self.flightrec is None:
+            return
+        try:
+            path = self.flightrec.dump(reason)
+        except Exception as e:  # noqa: BLE001 — a dump failure must never
+            _log.warning(f"flight-recorder dump failed: {e}")  # cascade
+            return
+        if path:
+            _log.warning(f"flight recorder: wrote {path} ({reason})")
 
     def _admit(self) -> None:
         """Breaker gate shared by /v1/flow and /v1/stream admission."""
@@ -291,7 +341,10 @@ class FlowServer:
                     "raft_serving_xla_recompiles_total",
                     "XLA compiles observed after warmup (watchdog)"),
                 run_log=tlm_events.current(),
-                log_fn=_log.warning).install()
+                log_fn=_log.warning,
+                # a post-warmup recompile is an incident: dump the traces
+                on_recompile=lambda: self._flight_dump("recompile")
+                ).install()
             tlm_watchdogs.hbm_gauges(self.registry, prefix="raft_serving")
         if self.sconfig.warmup and hasattr(self.engine, "warmup"):
             n = self.engine.warmup(verbose=self.verbose)
@@ -328,6 +381,9 @@ class FlowServer:
                 r.fail(Draining("server shut down before this request ran"))
         self.queue.close()            # batcher drains the rest, then exits
         self.batcher.join(timeout)
+        # SIGTERM/shutdown artifact: the drain is complete, so every
+        # in-flight trace has closed — the dump is the final word
+        self._flight_dump("shutdown")
         self._trace_window.stop()
         if self._recompile_watch is not None:
             self._recompile_watch.remove()
@@ -344,50 +400,85 @@ class FlowServer:
     # -- request path ------------------------------------------------------
 
     def infer(self, im1: np.ndarray, im2: np.ndarray,
-              deadline_ms: Optional[float] = None) -> Request:
+              deadline_ms: Optional[float] = None,
+              trace_id: Optional[str] = None,
+              finish_trace: bool = True) -> Request:
         """Route, pad, enqueue, block until resolved.  Called from HTTP
-        handler threads (and directly by tests/the in-process bench)."""
-        if self.draining:
-            self.count_request("draining")
-            raise Draining("server is draining; not accepting requests")
-        self._admit()                     # breaker gate: shed 503 while open
-        h, w = im1.shape[0], im1.shape[1]
-        bucket = self.sconfig.route(h, w)
-        if bucket is None:
-            raise BadRequest(
-                f"no declared bucket fits ({h}, {w}); buckets: "
-                f"{[f'{bh}x{bw}' for bh, bw in self.sconfig.buckets]}")
-        dl = self.sconfig.default_deadline_ms if deadline_ms is None \
-            else min(deadline_ms, self.sconfig.default_deadline_ms)
-        if dl <= 0:
-            raise BadRequest(f"deadline_ms must be positive, got {dl}")
-        im1p, pads = pad_to_shape(im1[None].astype(np.float32), bucket)
-        im2p, _ = pad_to_shape(im2[None].astype(np.float32), bucket)
-        req = Request(im1p, im2p, bucket, pads,
-                      deadline=time.monotonic() + dl / 1000.0)
+        handler threads (and directly by tests/the in-process bench).
+
+        Trace lifecycle: a trace is minted here (or adopts the client's
+        ``trace_id``) and CLOSES here on every failure path, with the
+        status the exception maps to — shed, timeout, poisoned, error —
+        and the exception carries ``.trace_id`` out to the HTTP layer.
+        On success the HTTP handler finishes it after the respond span
+        (``finish_trace=False``); direct callers let this method close it.
+        """
+        tr = self.tracer.start("pair", trace_id)
+        t0 = time.monotonic()
         try:
-            self.queue.submit(req)
-        except Draining:
-            self.count_request("draining")
+            if self.draining:
+                self.count_request("draining")
+                raise Draining("server is draining; not accepting requests")
+            self._admit()                 # breaker gate: shed 503 while open
+            h, w = im1.shape[0], im1.shape[1]
+            bucket = self.sconfig.route(h, w)
+            if bucket is None:
+                raise BadRequest(
+                    f"no declared bucket fits ({h}, {w}); buckets: "
+                    f"{[f'{bh}x{bw}' for bh, bw in self.sconfig.buckets]}")
+            dl = self.sconfig.default_deadline_ms if deadline_ms is None \
+                else min(deadline_ms, self.sconfig.default_deadline_ms)
+            if dl <= 0:
+                raise BadRequest(f"deadline_ms must be positive, got {dl}")
+            im1p, pads = pad_to_shape(im1[None].astype(np.float32), bucket)
+            im2p, _ = pad_to_shape(im2[None].astype(np.float32), bucket)
+            req = Request(im1p, im2p, bucket, pads,
+                          deadline=time.monotonic() + dl / 1000.0)
+            req.trace = tr
+            if tr is not None:
+                tr.span("admit", t0, time.monotonic(),
+                        bucket=f"{bucket[0]}x{bucket[1]}")
+            try:
+                self.queue.submit(req)
+            except Draining:
+                self.count_request("draining")
+                raise
+            except Exception:       # QueueFull: overload shed, HTTP 429
+                self.count_request("shed")
+                raise
+            # the generous margin past the deadline covers an in-flight
+            # batch that dequeued the request just before its deadline:
+            # it completes
+            try:
+                req.wait(timeout=dl / 1000.0 + max(30.0, dl / 1000.0))
+            except DeadlineExceeded:
+                if req.error is None:
+                    # wait() itself timed out (batch overran / batcher
+                    # stalled) — the batcher's purge never saw this one
+                    self.count_request("timeout")
+                raise
+        except BaseException as e:
+            if tr is not None:
+                # stamp-if-absent: a group-wide failure can share ONE
+                # exception instance across co-batched handlers, and the
+                # first stamp must not be overwritten with another
+                # request's id (the batcher fails shared errors with
+                # per-request instances precisely so this stays unique)
+                if getattr(e, "trace_id", None) is None:
+                    e.trace_id = tr.trace_id
+                tr.finish(tlm_spans.status_of(e))
             raise
-        except Exception:           # QueueFull: overload shed, HTTP 429
-            self.count_request("shed")
-            raise
-        # the generous margin past the deadline covers an in-flight batch
-        # that dequeued the request just before its deadline: it completes
-        try:
-            req.wait(timeout=dl / 1000.0 + max(30.0, dl / 1000.0))
-        except DeadlineExceeded:
-            if req.error is None:
-                # wait() itself timed out (batch overran / batcher stalled)
-                # — the batcher's purge accounting never saw this one
-                self.count_request("timeout")
-            raise
+        if finish_trace and tr is not None:
+            tr.finish()
         return req
 
-    def stream_call(self, op: str, session_id, image, deadline_ms):
+    def stream_call(self, op: str, session_id, image, deadline_ms,
+                    trace_id: Optional[str] = None,
+                    finish_trace: bool = True):
         """/v1/stream bridge: dispatch one open/advance/close to the
-        stream coordinator (http handler threads)."""
+        stream coordinator (http handler threads).  ``close`` is pure
+        bookkeeping and is never traced; open/advance follow the same
+        trace lifecycle as :meth:`infer` (the coordinator mints it)."""
         if self.streams is None:
             raise BadRequest("streaming is disabled on this server "
                              "(--max-sessions 0); use /v1/flow")
@@ -399,8 +490,16 @@ class FlowServer:
             return self.streams.close(session_id)
         self._admit()                     # breaker gate: shed 503 while open
         if op == "open":
-            return self.streams.open(image, deadline_ms)
-        return self.streams.advance(session_id, image, deadline_ms)
+            res = self.streams.open(image, deadline_ms, trace_id=trace_id,
+                                    finish_trace=finish_trace)
+        else:
+            res = self.streams.advance(session_id, image, deadline_ms,
+                                       trace_id=trace_id,
+                                       finish_trace=finish_trace)
+        if finish_trace:
+            res.pop("_trace", None)       # direct callers: already closed
+            res.pop("_finished_at", None)
+        return res
 
 
 def serve_cli(args, config: RAFTConfig, load_params) -> int:
@@ -410,6 +509,12 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
 
     from .config import parse_buckets
 
+    # flight recorder: default <out>/flightrec.jsonl; --flightrec '' turns
+    # the auto-dump off (the /debug/traces endpoint still serves the ring)
+    flightrec = getattr(args, "flightrec", None)
+    if flightrec is None:
+        flightrec = os.path.join(getattr(args, "out", None) or ".",
+                                 "flightrec.jsonl")
     try:
         sconfig = ServeConfig(
             buckets=parse_buckets(args.buckets),
@@ -421,6 +526,10 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
             dp_devices=args.serve_dp or 1,
             warmup=not args.no_warmup,
             iters_policy=getattr(args, "iters_policy", None),
+            trace_sample=getattr(args, "trace_sample", 1.0),
+            slo_pair_ms=getattr(args, "slo_pair_ms", 1000.0),
+            slo_stream_ms=getattr(args, "slo_stream_ms", 500.0),
+            flightrec_path=flightrec or None,
             # argparse owns the defaults; `or`-style fallbacks would
             # silently turn an (invalid) explicit 0 into the default
             # instead of letting ServeConfig raise on it
@@ -463,6 +572,12 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
     if server.faults is not None:
         print(f"[serve] CHAOS ARMED: {sconfig.chaos} "
               f"(fault injection live — drills only)")
+    if sconfig.trace_sample > 0:
+        print(f"[serve] tracing: sample={sconfig.trace_sample:g}  "
+              f"slo pair={sconfig.slo_pair_ms:.0f}ms "
+              f"stream={sconfig.slo_stream_ms:.0f}ms  "
+              f"flightrec={sconfig.flightrec_path or '(endpoint only)'}  "
+              f"GET {server.url}/debug/traces")
     print(f"[serve] POST {server.url}/v1/flow   "
           f"GET {server.url}/healthz   GET {server.url}/metrics")
 

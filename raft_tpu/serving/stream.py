@@ -38,6 +38,8 @@ import numpy as np
 
 from ..data.pipeline import pad_to_shape
 from ..ops.warmstart import warm_start_seed
+from ..telemetry import events as tlm_events
+from ..telemetry import spans as tlm_spans
 from .batcher import NonFiniteOutput
 from .queue import (DeadlineExceeded, Draining, RejectedError, Request,
                     RequestQueue)
@@ -88,7 +90,7 @@ class StreamCoordinator:
 
     def __init__(self, store: SessionStore, sconfig, queue: RequestQueue,
                  metrics: Dict, count_fn, faults=None, nonfinite=None,
-                 breaker=None):
+                 breaker=None, tracer=None):
         self.store = store
         self.sconfig = sconfig
         self.queue = queue
@@ -97,11 +99,13 @@ class StreamCoordinator:
         self.faults = faults             # chaos injector (session arm)
         self.nonfinite = nonfinite       # raft_nonfinite_outputs_total
         self.breaker = breaker           # CircuitBreaker or None
+        self.tracer = tracer             # telemetry.spans.Tracer or None
 
     # -- handler-thread API ------------------------------------------------
 
-    def open(self, image: np.ndarray,
-             deadline_ms: Optional[float]) -> Dict:
+    def open(self, image: np.ndarray, deadline_ms: Optional[float],
+             trace_id: Optional[str] = None,
+             finish_trace: bool = True) -> Dict:
         from .http import BadRequest    # circular-free: http imports us not
         self.store.sweep()
         h, w = image.shape[0], image.shape[1]
@@ -113,7 +117,9 @@ class StreamCoordinator:
         s = self.store.open(bucket)
         try:
             with s.lock:
-                self._run_step(s, "open", image, deadline_ms)
+                req = self._run_step(s, "open", image, deadline_ms,
+                                     trace_id=trace_id,
+                                     finish_trace=finish_trace)
         except BaseException:
             # no half-open sessions — but close AFTER releasing s.lock:
             # store.close takes the store lock, which the hierarchy orders
@@ -122,11 +128,17 @@ class StreamCoordinator:
             self.store.close(s.id)
             raise
         self.metrics["opens"].inc()
-        return {"session": s.id, "frame": 0,
-                "meta": {"bucket": list(bucket)}}
+        res = {"session": s.id, "frame": 0,
+               "meta": {"bucket": list(bucket)}, "_trace": req.trace,
+               "_finished_at": req.finished_at}
+        if req.trace is not None:
+            res["meta"]["trace_id"] = req.trace.trace_id
+        return res
 
     def advance(self, sid: Optional[str], image: np.ndarray,
-                deadline_ms: Optional[float]) -> Dict:
+                deadline_ms: Optional[float],
+                trace_id: Optional[str] = None,
+                finish_trace: bool = True) -> Dict:
         from .http import BadRequest
         self.store.sweep()
         s = self.store.get(sid) if sid else None
@@ -147,7 +159,9 @@ class StreamCoordinator:
                     f"frame ({h}, {w}) does not route to this session's "
                     f"bucket {s.bucket}; resolution changes mid-stream "
                     f"need a new session")
-            req = self._run_step(s, "advance", image, deadline_ms)
+            req = self._run_step(s, "advance", image, deadline_ms,
+                                 trace_id=trace_id,
+                                 finish_trace=finish_trace)
         finally:
             s.lock.release()
         meta = {"bucket": list(s.bucket), "warm": req.warm,
@@ -155,8 +169,11 @@ class StreamCoordinator:
                 "batch_padded": req.batch_padded}
         if req.iters_used is not None:
             meta["iters_used"] = req.iters_used
+        if req.trace is not None:
+            meta["trace_id"] = req.trace.trace_id
         return {"session": s.id, "frame": req.frame, "flow": req.result,
-                "meta": meta}
+                "meta": meta, "_trace": req.trace,
+                "_finished_at": req.finished_at}
 
     def close(self, sid: Optional[str]) -> Dict:
         s = self.store.close(sid) if sid else None
@@ -166,35 +183,61 @@ class StreamCoordinator:
         return {"session": sid, "closed": True, "frames": s.frames}
 
     def _run_step(self, s: Session, op: str, image: np.ndarray,
-                  deadline_ms: Optional[float]) -> StreamRequest:
+                  deadline_ms: Optional[float],
+                  trace_id: Optional[str] = None,
+                  finish_trace: bool = True) -> StreamRequest:
         """Pad, enqueue, block until the batcher resolves — the stream
-        twin of FlowServer.infer, same deadline/shed/drain accounting."""
+        twin of FlowServer.infer, same deadline/shed/drain accounting and
+        the same trace lifecycle: the trace closes HERE on every failure
+        path (status from the exception); on success the HTTP handler
+        finishes it after the respond span (``finish_trace=False``), or
+        this method does for direct callers."""
         from .http import BadRequest
-        dl = (self.sconfig.default_deadline_ms if deadline_ms is None
-              else min(deadline_ms, self.sconfig.default_deadline_ms))
-        if dl <= 0:
-            raise BadRequest(f"deadline_ms must be positive, got {dl}")
-        imp, pads = pad_to_shape(image[None].astype(np.float32), s.bucket)
-        req = StreamRequest(s, op, imp, pads,
-                            deadline=time.monotonic() + dl / 1000.0)
+        tr = (self.tracer.start("stream", trace_id)
+              if self.tracer is not None else None)
+        t0 = time.monotonic()
         try:
-            self.queue.submit(req)
-        except Draining:
-            self.count("draining")
+            dl = (self.sconfig.default_deadline_ms if deadline_ms is None
+                  else min(deadline_ms, self.sconfig.default_deadline_ms))
+            if dl <= 0:
+                raise BadRequest(f"deadline_ms must be positive, got {dl}")
+            imp, pads = pad_to_shape(image[None].astype(np.float32),
+                                     s.bucket)
+            req = StreamRequest(s, op, imp, pads,
+                                deadline=time.monotonic() + dl / 1000.0)
+            req.trace = tr
+            if tr is not None:
+                tr.span("admit", t0, time.monotonic(), op=op,
+                        session=s.id)
+            try:
+                self.queue.submit(req)
+            except Draining:
+                self.count("draining")
+                raise
+            except Exception:           # QueueFull: overload shed, HTTP 429
+                self.count("shed")
+                raise
+            try:
+                req.wait(timeout=dl / 1000.0 + max(30.0, dl / 1000.0))
+            except DeadlineExceeded:
+                # the step may still be queued (or mid-execution on a
+                # stalled device): mark it so the batcher drops it instead
+                # of advancing the session after this thread releases its
+                # lock
+                req.abandoned = True
+                if req.error is None:
+                    self.count("timeout")
+                raise
+        except BaseException as e:
+            if tr is not None:
+                # stamp-if-absent (see FlowServer.infer): never overwrite
+                # another request's id on a shared exception instance
+                if getattr(e, "trace_id", None) is None:
+                    e.trace_id = tr.trace_id
+                tr.finish(tlm_spans.status_of(e))
             raise
-        except Exception:               # QueueFull: overload shed, HTTP 429
-            self.count("shed")
-            raise
-        try:
-            req.wait(timeout=dl / 1000.0 + max(30.0, dl / 1000.0))
-        except DeadlineExceeded:
-            # the step may still be queued (or mid-execution on a stalled
-            # device): mark it so the batcher drops it instead of
-            # advancing the session after this thread releases its lock
-            req.abandoned = True
-            if req.error is None:
-                self.count("timeout")
-            raise
+        if finish_trace and tr is not None:
+            tr.finish()
         return req
 
     # -- batcher-thread API ------------------------------------------------
@@ -234,6 +277,10 @@ class StreamCoordinator:
             s.drop_features()
             self.store._evict("degraded")
             self.metrics["degraded"].inc()
+            if req.trace is not None:
+                # the client gets a 200 but the trace says what it cost:
+                # degraded outranks ok and is always recorder-retained
+                req.trace.set_status(tlm_spans.DEGRADED)
             flow, iters_used = self._advance_once(s, req, engine,
                                                   warm=False)
             warm = False
@@ -267,6 +314,11 @@ class StreamCoordinator:
             # HTTP edge): never cache poisoned maps or a poisoned seed
             if self.nonfinite is not None:
                 self.nonfinite.inc()
+            log = tlm_events.current()
+            if log is not None:
+                log.event("nonfinite_output", session=s.id, warm=warm,
+                          trace_id=(req.trace.trace_id
+                                    if req.trace is not None else None))
             raise NonFiniteOutput(
                 f"non-finite stream output for session {s.id} on a "
                 f"{'warm' if warm else 'cold'} step")

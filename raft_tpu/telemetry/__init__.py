@@ -15,6 +15,9 @@ Four small, dependency-free layers shared by train, serve, and bench:
 * :mod:`watchdogs` — opt-in recompile counter (stack-wide twin of the
   serving engine's hit/miss accounting), implicit-transfer guard, HBM
   gauges, and the NaN/Inf sentinel with stage provenance.
+* :mod:`spans` — request-scoped tracing for the serving plane: ID-carrying
+  spans with parent links and status, the flight recorder, and SLO burn
+  accounting (``tools/tlm.py trace`` renders the waterfalls).
 
 ``registry`` and ``events`` import no jax at module level (the linter and
 the manifest tooling must run without it); ``trace`` / ``watchdogs``
@@ -27,3 +30,5 @@ from .events import (RunLog, config_hash, read_events,  # noqa: F401
                      run_manifest, start_run)
 from .log import get_logger  # noqa: F401
 from .trace import TraceWindow, current_stage, stage  # noqa: F401
+from .spans import (FlightRecorder, RequestTrace,  # noqa: F401
+                    SLOTracker, Tracer)

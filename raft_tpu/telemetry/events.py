@@ -147,7 +147,10 @@ class RunLog:
         if manifest is not None:
             self.event("manifest", **manifest)
 
-    def event(self, kind: str, **fields) -> dict:
+    # positional-only first parameter: event payloads may legitimately
+    # carry a "kind" field of their own (e.g. the trace records' request
+    # class) and must not collide with the event name
+    def event(self, kind: str, /, **fields) -> dict:
         rec = {"t": round(time.time(), 3), "event": kind}
         rec.update(fields)
         if self._f is not None:

@@ -139,6 +139,11 @@ class Gauge(_Metric):
         with self._lock:
             self._value = float(v)
 
+    def set_fn(self, fn) -> None:
+        """Make this gauge (or a labeled child — the family constructor
+        can't reach children) a live callback sampled at render time."""
+        self._fn = fn
+
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
             self._value += amount

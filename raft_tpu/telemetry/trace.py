@@ -14,6 +14,10 @@ to any per-step loop (val batches, bench reps, serve device batches):
 construct with a trace dir + window, call ``on_step(i)`` once per step, and
 the jax.profiler trace starts/stops itself; ``stop()`` in a finally block
 covers early exits.
+
+Stages name *code*; :mod:`spans` extends this layer to name *requests* —
+ID-carrying spans with parent links and status threaded through the
+serving plane (queue wait vs device execute vs respond, per request).
 """
 
 from __future__ import annotations

@@ -42,6 +42,7 @@ import time
 from typing import Dict, List, Optional, Set
 
 from .log import get_logger
+from .spans import current_trace_ids
 from .trace import current_stage
 
 _log = get_logger("watchdog")
@@ -72,7 +73,8 @@ class RecompileWatch:
     _listener_installed = False
     _lock = threading.Lock()
 
-    def __init__(self, counter=None, run_log=None, log_fn=None):
+    def __init__(self, counter=None, run_log=None, log_fn=None,
+                 on_recompile=None):
         self.compiles = 0                  # total since construction
         self.warmup_compiles = 0
         self.recompiles = 0                # compiles after arm()
@@ -81,6 +83,7 @@ class RecompileWatch:
         self._counter = counter            # telemetry.registry.Counter
         self._run_log = run_log            # telemetry.events.RunLog
         self._log_fn = log_fn
+        self._on_recompile = on_recompile  # e.g. a flight-recorder dump
 
     def install(self) -> "RecompileWatch":
         with RecompileWatch._lock:
@@ -135,6 +138,13 @@ class RecompileWatch:
             self._log_fn(msg)
         else:
             _log.warning(msg)
+        if self._on_recompile is not None:
+            # watchdog-fire hook (the serving flight recorder dumps here);
+            # never let a consumer error kill the monitoring listener
+            try:
+                self._on_recompile()
+            except Exception as e:  # noqa: BLE001
+                _log.warning(f"on_recompile hook failed: {e}")
 
 
 # ------------------------------------------------------ implicit transfers
@@ -399,6 +409,11 @@ class LockOrderValidator:
 
     def _violation(self, kind: str, msg: str) -> None:
         rec = {"kind": kind, "msg": msg, "thread": threading.current_thread().name}
+        ids = current_trace_ids()
+        if ids:
+            # join key: the request traces in flight on this thread when
+            # the violation fired (telemetry/spans.py ambient)
+            rec["trace_ids"] = list(ids)
         with self._meta:
             self.order_violations += 1
             self.violations.append(rec)
@@ -411,6 +426,9 @@ class LockOrderValidator:
         rec = {"kind": "hold", "lock": name, "held_s": round(held_s, 4),
                "budget_s": budget,
                "thread": threading.current_thread().name}
+        ids = current_trace_ids()
+        if ids:
+            rec["trace_ids"] = list(ids)
         with self._meta:
             self.hold_violations += 1
             self.violations.append(rec)

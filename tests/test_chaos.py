@@ -552,6 +552,59 @@ def test_stream_session_corruption_caught_by_sentinel(chaos_stream_server):
     _post_stream(server, {"op": "close", "session": sid})
 
 
+def test_degraded_advance_trace_retained_and_fault_joinable(
+        chaos_stream_server, tmp_path):
+    """Span lifecycle under the degrade ladder: a warm advance whose
+    stream step faults answers 200 but its trace closes ``degraded`` —
+    always retained by the flight recorder — and the drill's
+    fault_injected run-log event carries the trace id it poisoned (the
+    chaos <-> trace join the ISSUE asks for).  No spans leak open."""
+    from raft_tpu.telemetry import events as tlm_events
+
+    server = chaos_stream_server
+    log = tlm_events.RunLog(tmp_path / "events.jsonl")
+    tlm_events.set_current(log)
+    try:
+        server.faults.run_log = log
+        rng = np.random.RandomState(52)
+        frames = [rng.rand(32, 48, 3).astype(np.float32) for _ in range(3)]
+        sid = _post_stream(server, {"image": frames[0].tolist()})["session"]
+        _post_stream(server, {"session": sid, "image": frames[1].tolist()})
+        server.faults.force("engine_error", [1])
+        r2 = _post_stream(server, {"session": sid,
+                                   "image": frames[2].tolist()})
+        assert r2["meta"]["warm"] is False           # degraded to cold
+        tid = r2["meta"]["trace_id"]
+        # the handler finishes the trace AFTER writing the response —
+        # poll briefly (eventual visibility, same as /debug/traces)
+        deadline = time.monotonic() + 5.0
+        degraded = []
+        while time.monotonic() < deadline:
+            degraded = [t for t in server.flightrec.snapshot()
+                        if t["status"] == "degraded"
+                        and t["trace_id"] == tid]
+            if degraded:
+                break
+            time.sleep(0.02)
+        assert degraded
+        # the faulted warm device call is visible inside the trace: an
+        # execute span with at least one extra device call (the cold
+        # re-encode + re-run) behind it
+        [trace] = degraded
+        assert sum(s["name"] == "execute_dispatch"
+                   for s in trace["spans"]) >= 2
+        assert server.tracer.open_traces == 0
+        # the fault event joins to the trace it hit
+        recs = tlm_events.read_events(tmp_path / "events.jsonl")
+        fault = [r for r in recs if r.get("event") == "fault_injected"]
+        assert fault and tid in (fault[-1].get("trace_ids") or [])
+        _post_stream(server, {"op": "close", "session": sid})
+    finally:
+        server.faults.run_log = None
+        tlm_events.set_current(None)
+        log.close()
+
+
 def test_session_store_demote_all_skips_inflight():
     store = SessionStore(max_sessions=4, ttl_s=60.0)
     a, b = store.open(BUCKET), store.open(BUCKET)

@@ -881,6 +881,125 @@ def test_stream_converge_policy_end_to_end():
         server.stop()
 
 
+# ------------------------------------------------- request tracing (live) --
+
+def _poll_debug_traces(server, trace_id, timeout=5.0):
+    """A trace is finished by the handler AFTER the response bytes go out,
+    so a client can race /debug/traces against its own request's closing
+    statements — poll briefly (eventual visibility is the contract)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        with urllib.request.urlopen(
+                server.url + f"/debug/traces?trace_id={trace_id}") as r:
+            dbg = json.loads(r.read())
+        if dbg["traces"] or time.monotonic() > deadline:
+            return dbg
+        time.sleep(0.02)
+
+
+def test_live_trace_meta_timings_and_debug_endpoint(live_server):
+    """The tracing contract over real HTTP: a client-supplied
+    X-Raft-Trace-Id is adopted and echoed (meta + header), meta.timings
+    carries the server-side breakdown, /debug/traces serves the trace by
+    id, the top-level spans account for the server-side e2e, and nothing
+    leaks open."""
+    server, _, _ = live_server
+    rng = np.random.RandomState(40)
+    im = rng.rand(32, 48, 3).astype(np.float32)
+    payload = {"image1": im.tolist(), "image2": im.tolist()}
+    req = urllib.request.Request(
+        server.url + "/v1/flow", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Raft-Trace-Id": "CAFED00D-7e57"})
+    with urllib.request.urlopen(req) as r:
+        hdr_tid = r.headers["X-Raft-Trace-Id"]
+        hdr_timings = json.loads(r.headers["X-Raft-Timings"])
+        resp = json.loads(r.read())
+    assert resp["meta"]["trace_id"] == "cafed00d-7e57" == hdr_tid
+    timings = resp["meta"]["timings"]
+    assert timings == hdr_timings
+    for name in ("admit", "queue_wait", "batch_form", "pad", "execute",
+                 "execute_dispatch", "execute_block"):
+        assert name in timings, name
+    # dispatch + block partition the device call (within rounding)
+    assert timings["execute"] >= timings["execute_dispatch"]
+
+    dbg = _poll_debug_traces(server, "cafed00d")
+    assert dbg["open_traces"] == 0
+    [trace] = dbg["traces"]
+    assert trace["status"] == "ok" and trace["kind"] == "pair"
+    spans = trace["spans"]
+    root = next(s for s in spans if s["name"] == "request")
+    assert any(s["name"] == "respond" for s in spans)
+    top = sum(s["dur_ms"] for s in spans if s.get("parent") == root["span"])
+    # the acceptance bar: spans account for the request's e2e latency
+    assert top >= 0.9 * root["dur_ms"]
+
+
+def test_stream_advance_carries_trace_and_step_metrics(stream_server):
+    """Stream advances report meta.trace_id + meta.timings, and the
+    stream-step families (the occupancy-gap fix) observe every device
+    step at batch 1 / occupancy 1.0."""
+    server, _, _ = stream_server
+    before = server.registry.get("raft_stream_steps_total").value
+    frames = _frames(60, 3)
+    r0 = _post_stream(server, {"image": frames[0].tolist()})
+    sid = r0["session"]
+    assert "trace_id" in r0["meta"]
+    r1 = _post_stream(server, {"session": sid, "image": frames[1].tolist()})
+    assert "trace_id" in r1["meta"]
+    tm = r1["meta"]["timings"]
+    assert "queue_wait" in tm and "execute" in tm
+    # the stream device call is split dispatch/block too
+    assert "execute_dispatch" in tm and "execute_block" in tm
+    reg = server.registry
+    assert reg.get("raft_stream_steps_total").value >= before + 2
+    assert reg.get("raft_stream_step_seconds").count >= 2
+    # batch 1 / occupancy 1.0: the baseline continuous batching must beat
+    assert reg.get("raft_stream_step_batch").sum == \
+        reg.get("raft_stream_step_batch").count
+    assert reg.get("raft_stream_step_occupancy").sum == \
+        reg.get("raft_stream_step_occupancy").count
+    dbg = _poll_debug_traces(server, r1["meta"]["trace_id"])
+    assert dbg["traces"] and dbg["traces"][0]["kind"] == "stream"
+    _post_stream(server, {"op": "close", "session": sid})
+
+
+def test_new_metric_families_prometheus_round_trip(stream_server):
+    """Exposition round-trip for the families this PR adds: render ->
+    parse -> the histograms are internally consistent (cumulative
+    buckets nondecreasing, +Inf == count) and the SLO gauges parse as
+    floats."""
+    server, _, _ = stream_server
+    with urllib.request.urlopen(server.url + "/metrics") as r:
+        text = r.read().decode()
+    # minimal Prometheus text parser (serve_bench carries the same shape)
+    import re
+    parsed = {}
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        m = re.match(r"^(\S+?)(\{[^}]*\})?\s+(\S+)$", ln)
+        assert m, f"unparseable exposition line: {ln!r}"
+        parsed[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+    for fam in ("raft_stream_step_seconds", "raft_stream_step_batch",
+                "raft_stream_step_occupancy"):
+        count = parsed[f"{fam}_count"]
+        buckets = sorted(
+            ((float("inf") if k.split('le="')[1].rstrip('"}') == "+Inf"
+              else float(k.split('le="')[1].rstrip('"}'))), v)
+            for k, v in parsed.items() if k.startswith(f"{fam}_bucket"))
+        assert buckets, fam
+        cums = [v for _, v in buckets]
+        assert cums == sorted(cums), f"{fam}: buckets not cumulative"
+        assert cums[-1] == count, f"{fam}: +Inf bucket != count"
+        assert f"{fam}_sum" in parsed
+    assert parsed['raft_slo_burn_rate{class="pair"}'] >= 0.0
+    assert parsed['raft_slo_burn_rate{class="stream"}'] >= 0.0
+    assert 'raft_slo_violations_total{class="pair"}' in parsed
+    assert parsed["raft_stream_steps_total"] >= 1
+
+
 # ------------------------------------------------------------- CLI wiring --
 
 def test_serve_cli_rejects_bad_buckets(capsys):

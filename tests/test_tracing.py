@@ -1,0 +1,446 @@
+"""Request-scoped tracing tests (tier-1, CPU): the span/tracer/flight-
+recorder/SLO primitives (telemetry/spans.py), the serving integration on
+stub engines (no compiles, deterministic failures), and the tlm trace
+renderer.  The live-HTTP tracing path is covered in test_serving.py; the
+chaos-drill correlation in test_chaos.py.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.serving import (BreakerOpen, DeadlineExceeded, FlowServer,
+                              PoisonedRequest, QueueFull, Registry,
+                              ServeConfig)
+from raft_tpu.serving.batcher import BatcherCrashed
+from raft_tpu.serving.metrics import make_slo_metrics
+from raft_tpu.telemetry import spans
+
+from test_serving import BUCKET, StubEngine, make_request  # noqa: F401
+
+
+# ------------------------------------------------------ span primitives --
+
+def test_trace_records_spans_and_closes_once():
+    tracer = spans.Tracer(sample=1.0)
+    tr = tracer.start("pair", trace_id=None)
+    assert tracer.open_traces == 1
+    t = time.monotonic()
+    eid = tr.span("execute", t, t + 0.010, batch_real=2)
+    tr.span("execute_block", t + 0.002, t + 0.010, parent=eid)
+    rec = tr.finish()
+    assert tracer.open_traces == 0 and tracer.finished == 1
+    assert rec["status"] == "ok" and rec["kind"] == "pair"
+    names = [s["name"] for s in rec["spans"]]
+    assert names[0] == "request"                      # synthesized root
+    root = rec["spans"][0]
+    assert root["parent"] is None and rec["dur_ms"] == root["dur_ms"]
+    by_name = {s["name"]: s for s in rec["spans"]}
+    # parentless spans were re-parented onto the root; explicit parents kept
+    assert by_name["execute"]["parent"] == root["span"]
+    assert by_name["execute_block"]["parent"] == eid
+    assert by_name["execute"]["batch_real"] == 2
+    assert abs(by_name["execute"]["dur_ms"] - 10.0) < 2.0
+    # closed: further spans/finishes are no-ops
+    assert tr.finish() is None
+    assert tr.span("late", t, t + 1.0) is None
+    assert tr.timings_ms()["execute"] > 0
+
+
+def test_status_escalation_and_exception_mapping():
+    tracer = spans.Tracer(sample=1.0)
+    tr = tracer.start("stream")
+    tr.set_status(spans.DEGRADED)
+    tr.set_status(spans.OK)                # cannot de-escalate
+    assert tr.finish()["status"] == "degraded"
+    # exception -> status taxonomy (the classes carry trace_status)
+    assert spans.status_of(QueueFull("x")) == "shed"
+    assert spans.status_of(BreakerOpen("x")) == "shed"
+    assert spans.status_of(DeadlineExceeded("x")) == "timeout"
+    assert spans.status_of(PoisonedRequest("x")) == "poisoned"
+    assert spans.status_of(BatcherCrashed("x")) == "error"
+    assert spans.status_of(ValueError("x")) == "error"
+
+
+def test_clean_trace_id():
+    assert spans.clean_trace_id("ABCDEF-123") == "abcdef-123"
+    minted = spans.clean_trace_id(None)
+    assert len(minted) == 32 and spans.clean_trace_id(minted) == minted
+    # junk (too long / bad chars) is replaced, never echoed into logs
+    assert spans.clean_trace_id("x" * 100) != "x" * 100
+    assert "<" not in spans.clean_trace_id("<script>")
+
+
+def test_systematic_sampling_retains_errors():
+    fr = spans.FlightRecorder(capacity=64)
+    tracer = spans.Tracer(sample=0.25, recorder=fr)
+    for _ in range(16):
+        tracer.start("pair").finish()
+    ok, err = fr.counts()
+    assert ok == 4 and err == 0            # exact-rate systematic sampling
+    # error traces are retained regardless of the sampling decision
+    for _ in range(8):
+        tracer.start("pair").finish(spans.POISONED)
+    ok, err = fr.counts()
+    assert ok == 4 and err == 8
+    assert tracer.open_traces == 0
+
+
+def test_sample_zero_disables_tracing():
+    tracer = spans.Tracer(sample=0.0)
+    assert tracer.start("pair") is None
+    assert tracer.open_traces == 0
+
+
+def test_flight_recorder_rings_and_dump(tmp_path):
+    path = tmp_path / "flightrec.jsonl"
+    fr = spans.FlightRecorder(capacity=4, path=path)
+    for i in range(10):
+        fr.add({"trace_id": f"ok{i}", "status": "ok", "t": float(i)})
+    fr.add({"trace_id": "bad", "status": "error", "t": 99.0})
+    ok, err = fr.counts()
+    assert ok == 4 and err == 1            # ring bounded; errors separate
+    snap = fr.snapshot()
+    assert [r["trace_id"] for r in snap] == ["ok6", "ok7", "ok8", "ok9",
+                                             "bad"]
+    out = fr.dump("unit_test")
+    assert out == str(path) and fr.dumps == 1
+    recs = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert recs[0]["event"] == "flightrec_dump"
+    assert recs[0]["reason"] == "unit_test" and recs[0]["traces"] == 5
+    assert len(recs) == 6
+    # an error storm cannot evict its own evidence
+    for i in range(10):
+        fr.add({"trace_id": f"e{i}", "status": "error", "t": 200.0 + i})
+    ok, err = fr.counts()
+    assert ok == 4 and err == 4            # error ring bounded too
+    # ...and neither can a SHED storm: breaker-open sheds are one trace
+    # per rejected request — they ride the recency ring, never the
+    # evidence ring holding the errors that explain the open
+    for i in range(10):
+        fr.add({"trace_id": f"s{i}", "status": "shed", "t": 300.0 + i})
+    ok, err = fr.counts()
+    assert ok == 4 and err == 4
+    assert all(r["status"] == "error"      # evidence intact
+               for r in fr.snapshot() if r["trace_id"].startswith("e"))
+    # no path configured -> dump is a no-op, not an error
+    assert spans.FlightRecorder(capacity=2).dump("x") is None
+
+
+def test_slo_tracker_burn_rate_and_metrics():
+    slo = spans.SLOTracker(objectives={"pair": 0.100, "stream": 0.050},
+                           budget=0.1, window=10)
+    reg = Registry()
+    make_slo_metrics(reg, slo)
+    for _ in range(8):
+        slo.observe("pair", spans.OK, 0.010)         # fast + ok: no burn
+    slo.observe("pair", spans.OK, 0.500)             # slow: burns
+    slo.observe("pair", spans.POISONED, 0.010)       # failed: burns
+    slo.observe("pair", spans.DEGRADED, 0.010)       # degraded+fast: ok
+    slo.observe("pair", spans.BAD_REQUEST, 9.9)      # client junk: ignored
+    slo.observe("other", spans.OK, 9.9)              # unknown class: ignored
+    # window of 10 holds the last 10: 2 violations / 10 / budget 0.1 = 2.0
+    assert abs(slo.burn_rate("pair") - 2.0) < 1e-9
+    assert slo.burn_rate("stream") == 0.0            # nothing observed
+    text = reg.render()
+    assert 'raft_slo_burn_rate{class="pair"} 2' in text
+    assert 'raft_slo_violations_total{class="pair"} 2' in text
+    assert 'raft_slo_violations_total{class="stream"} 0' in text
+
+
+def test_device_slot_and_ambient_trace_ids():
+    assert spans.take_device_slot() is None
+    spans.record_device_call("pair", 0.0, 1.0, 2.0)  # no slot: dropped
+    spans.set_device_slot([])
+    spans.record_device_call("pair", 0.0, 1.0, 2.0)
+    spans.record_device_call("encode", 2.0, 3.0, 3.0)
+    assert spans.take_device_slot() == [("pair", 0.0, 1.0, 2.0),
+                                        ("encode", 2.0, 3.0, 3.0)]
+    assert spans.take_device_slot() is None          # take clears
+    assert spans.current_trace_ids() == ()
+    spans.set_current_trace_ids(("a", "b"))
+    assert spans.current_trace_ids() == ("a", "b")
+    spans.set_current_trace_ids(())
+    assert spans.current_trace_ids() == ()
+
+
+# ----------------------------------------- serving integration (stubs) --
+
+def _server(engine, **cfg):
+    defaults = dict(buckets=(BUCKET,), max_batch=4, batch_steps=(1, 2, 4),
+                    max_wait_ms=5.0, queue_depth=16, port=0, max_sessions=0,
+                    retry_backoff_ms=1.0, default_deadline_ms=10_000.0)
+    defaults.update(cfg)
+    server = FlowServer(None, None, ServeConfig(**defaults), engine=engine)
+    server.start()
+    return server
+
+
+def test_ok_request_trace_accounts_for_its_latency():
+    server = _server(StubEngine())
+    try:
+        im = np.zeros((32, 48, 3), np.float32)
+        req = server.infer(im, im)
+        assert req.trace is not None and req.trace.closed
+        assert server.tracer.open_traces == 0
+        [rec] = server.flightrec.snapshot()
+        assert rec["status"] == "ok"
+        names = {s["name"] for s in rec["spans"]}
+        assert {"request", "admit", "queue_wait", "batch_form", "pad",
+                "execute"} <= names
+        root = rec["spans"][0]
+        top = sum(s["dur_ms"] for s in rec["spans"]
+                  if s.get("parent") == root["span"])
+        # direct callers have no respond span; everything up to resolve
+        # must still be accounted
+        assert top >= 0.8 * root["dur_ms"]
+    finally:
+        server.stop()
+
+
+def test_client_trace_id_adopted():
+    server = _server(StubEngine())
+    try:
+        im = np.zeros((32, 48, 3), np.float32)
+        req = server.infer(im, im, trace_id="FEEDFACE-01")
+        assert req.trace.trace_id == "feedface-01"
+        assert any(t["trace_id"] == "feedface-01"
+                   for t in server.flightrec.snapshot())
+    finally:
+        server.stop()
+
+
+def test_cobatched_requests_share_one_execute_span():
+    gate = threading.Event()
+    eng = StubEngine(gate=gate)
+    server = _server(eng, max_wait_ms=200.0)
+    try:
+        im = np.zeros((32, 48, 3), np.float32)
+        # occupy the engine so the next two coalesce into one batch
+        warm = threading.Thread(target=server.infer, args=(im, im))
+        warm.start()
+        assert eng.entered.wait(10)
+        done = []
+        ts = [threading.Thread(target=lambda: done.append(
+            server.infer(im, im))) for _ in range(2)]
+        for t in ts:
+            t.start()
+        time.sleep(0.3)                     # both queued behind the gate
+        gate.set()
+        for t in ts:
+            t.join(10)
+        warm.join(10)
+        recs = [r for r in server.flightrec.snapshot()
+                if any(s.get("batch_real") == 2 for s in r["spans"])]
+        assert len(recs) == 2
+        exec_ids = set()
+        for rec in recs:
+            [ex] = [s for s in rec["spans"] if s["name"] == "execute"]
+            assert ex["batch_real"] == 2
+            exec_ids.add(ex["span"])
+        assert len(exec_ids) == 1           # ONE device span, two traces
+    finally:
+        gate.set()
+        server.stop()
+
+
+def test_failure_paths_close_traces_with_the_right_status():
+    """Poisoned (single-request bisection terminus), shed (breaker), and
+    timeout (queue purge) each close their trace with the taxonomy status
+    — and no trace leaks open."""
+    eng = StubEngine(fail=True)
+    server = _server(eng, breaker_window=8, breaker_threshold=0.5,
+                     breaker_min_volume=2, breaker_cooldown_s=30.0,
+                     engine_retries=0)
+    try:
+        im = np.zeros((32, 48, 3), np.float32)
+        for _ in range(2):
+            with pytest.raises(PoisonedRequest) as ei:
+                server.infer(im, im)
+        assert ei.value.trace_id            # the 500 carries its trace id
+        assert server.breaker.state == "open"
+        with pytest.raises(BreakerOpen) as eb:
+            server.infer(im, im)
+        assert eb.value.trace_id
+        statuses = [r["status"] for r in server.flightrec.snapshot()]
+        assert statuses.count("poisoned") == 2
+        assert statuses.count("shed") == 1
+        assert server.tracer.open_traces == 0
+    finally:
+        server.stop()
+
+
+def test_timeout_trace_closed_by_queue_purge():
+    gate = threading.Event()
+    eng = StubEngine(gate=gate)
+    server = _server(eng, max_batch=1, batch_steps=(1,))
+    try:
+        im = np.zeros((32, 48, 3), np.float32)
+        blocker = threading.Thread(target=server.infer, args=(im, im))
+        blocker.start()
+        assert eng.entered.wait(10)
+        # release the engine shortly: the batcher's next take_batch pass
+        # purges the expired request long before the handler's margin
+        threading.Timer(0.3, gate.set).start()
+        with pytest.raises(DeadlineExceeded):
+            server.infer(im, im, deadline_ms=50.0)   # purged in queue
+        blocker.join(10)
+        timeouts = [r for r in server.flightrec.snapshot()
+                    if r["status"] == "timeout"]
+        assert len(timeouts) == 1
+        names = [s["name"] for s in timeouts[0]["spans"]]
+        assert "queue_wait" in names        # its life WAS queue wait
+        assert "execute" not in names       # never reached the device
+        assert server.tracer.open_traces == 0
+    finally:
+        gate.set()
+        server.stop()
+
+
+def test_batcher_crash_closes_trace_and_dumps_flightrec(tmp_path):
+    path = tmp_path / "flightrec.jsonl"
+    server = _server(StubEngine(), chaos="seed=1", degraded_window_s=0.2,
+                     flightrec_path=str(path))
+    try:
+        server.faults.force("kill", [1])
+        im = np.zeros((32, 48, 3), np.float32)
+        with pytest.raises(BatcherCrashed):
+            server.infer(im, im)
+        assert server.tracer.open_traces == 0
+        assert any(r["status"] == "error"
+                   for r in server.flightrec.snapshot())
+        # the crash auto-dumps an artifact — on the DYING batcher thread,
+        # which races this (already-woken) one: poll briefly
+        deadline = time.monotonic() + 5.0
+        while not path.exists() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert path.exists()
+        recs = [json.loads(ln) for ln in path.read_text().splitlines()]
+        assert recs[0]["event"] == "flightrec_dump"
+        assert recs[0]["reason"] == "batcher_crash"
+        assert any(r.get("event") == "trace" and r["status"] == "error"
+                   for r in recs)
+    finally:
+        server.stop()
+
+
+def test_bad_request_burns_no_budget_and_keeps_error_ring_clean():
+    """A client's 400 closes its trace as ``bad_request``: the trace id
+    still comes back on the exception (debuggable), but no SLO budget
+    burns and the error ring stays reserved for real failures."""
+    from raft_tpu.serving.http import BadRequest
+    server = _server(StubEngine())
+    try:
+        big = np.zeros((256, 256, 3), np.float32)    # routes to no bucket
+        with pytest.raises(BadRequest) as ei:
+            server.infer(big, big)
+        assert ei.value.trace_id                     # findable afterwards
+        assert server.tracer.open_traces == 0
+        _, err = server.flightrec.counts()
+        assert err == 0                              # not incident evidence
+        assert any(t["status"] == "bad_request"
+                   for t in server.flightrec.snapshot())
+        assert server.slo.burn_rate("pair") == 0.0   # no budget burned
+    finally:
+        server.stop()
+
+
+def test_trace_sample_zero_is_off_everywhere():
+    import urllib.error
+    import urllib.request
+    server = _server(StubEngine(), trace_sample=0.0)
+    try:
+        im = np.zeros((32, 48, 3), np.float32)
+        req = server.infer(im, im)
+        assert req.trace is None
+        assert server.flightrec is None and server.slo is None
+        text = server.registry.render()
+        assert "raft_slo" not in text       # no tracing families at all
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(server.url + "/debug/traces")
+        assert ei.value.code == 404
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------ tlm trace --
+
+def _load_tlm():
+    spec = importlib.util.spec_from_file_location(
+        "tlm_under_test", os.path.join(os.path.dirname(__file__), "..",
+                                       "tools", "tlm.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _sample_trace_records():
+    """Two realistic trace records via the real tracer."""
+    fr = spans.FlightRecorder(capacity=8)
+    tracer = spans.Tracer(sample=1.0, recorder=fr)
+    for status in (None, spans.POISONED):
+        tr = tracer.start("pair")
+        t = tr.t0
+        tr.span("admit", t, t + 0.001)
+        tr.span("queue_wait", t + 0.001, t + 0.004)
+        eid = tr.span("execute", t + 0.004, t + 0.020)
+        tr.span("execute_dispatch", t + 0.004, t + 0.006, parent=eid)
+        tr.span("execute_block", t + 0.006, t + 0.020, parent=eid)
+        tr.span("respond", t + 0.020, t + 0.021)
+        tr.finish(status)
+    return fr.snapshot()
+
+
+def test_tlm_trace_list_render_and_attribution(tmp_path):
+    tlm = _load_tlm()
+    recs = _sample_trace_records()
+    log = tmp_path / "flightrec.jsonl"
+    log.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+
+    records = tlm.load_records(log)
+    assert len(tlm.trace_records(records)) == 2
+    listing = "\n".join(tlm.trace_list_lines(records))
+    assert "2 trace(s)" in listing and "poisoned" in listing
+    # non-ok traces list first
+    assert listing.splitlines()[1].split()[1].startswith("[pair")
+
+    rendered = "\n".join(tlm.render_trace(tlm.trace_records(records)[0]))
+    for name in ("request", "admit", "queue_wait", "execute",
+                 "execute_dispatch", "execute_block", "respond"):
+        assert name in rendered, name
+    assert "█" in rendered                  # the waterfall bars
+    # children indent under their parent
+    exec_line = next(ln for ln in rendered.splitlines()
+                     if "execute_block" in ln)
+    assert exec_line.lstrip().startswith("execute_block") is False \
+        or "  execute_block" in rendered
+
+    att = "\n".join(tlm.attribution_lines(records))
+    assert "latency attribution over 2 trace(s)" in att
+    assert "queue_wait" in att and "% of e2e" in att
+    # summary integrates the table
+    summary = "\n".join(tlm.summary_lines(log))
+    assert "latency attribution" in summary
+
+    # the CLI: list (exit 0), render by prefix, miss (exit 1)
+    assert tlm.main(["trace", str(log)]) == 0
+    tid = tlm.trace_records(records)[0]["trace_id"]
+    assert tlm.main(["trace", str(log), tid[:8]]) == 0
+    assert tlm.main(["trace", str(log), "zzzz"]) == 1
+
+
+def test_tlm_trace_reads_run_dir_with_flightrec(tmp_path):
+    tlm = _load_tlm()
+    recs = _sample_trace_records()
+    (tmp_path / "flightrec.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in recs) + "\n")
+    (tmp_path / "events.jsonl").write_text(
+        json.dumps({"t": 0, "event": "manifest", "mode": "serve"}) + "\n")
+    records = tlm.load_records(tmp_path)    # dir: events + flightrec merge
+    assert len(tlm.trace_records(records)) == 2
+    assert tlm.manifest_of(records) is not None

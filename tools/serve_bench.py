@@ -19,7 +19,13 @@ and appended to ``BENCH_serving.json`` (one JSON object per line).
 
 ``--smoke`` is the CI fast path: tiny model, tiny bucket, a few dozen
 requests; exits nonzero if the batcher never coalesced (occupancy <= 1)
-or anything recompiled after warmup.
+or anything recompiled after warmup.  It also audits the request-tracing
+plane: an untraced control phase pins the tracing overhead under 5%
+pairs/s, the per-request ``X-Raft-Timings`` breakdown (queue wait vs
+execute p95) is recorded next to the client's e2e numbers, and
+``/debug/traces`` is checked for span accounting — every ok request's
+top-level spans must cover >= 95% of its server-side e2e on average
+(with dispatch and block-until-ready split), and no trace may leak open.
 
 ``--chaos SPEC`` arms the fault injector (serving/faults.py) on the
 in-process server and turns the run into a **self-healing drill**: the
@@ -94,16 +100,22 @@ def hist_percentile(prom, name: str, q: float):
 
 
 class Client:
-    """One keep-alive connection + the shared accounting."""
+    """One keep-alive connection + the shared accounting.  When a
+    ``timings`` list is provided, the server-side per-span breakdown
+    (the ``X-Raft-Timings`` response header, ms) is collected per
+    request — the queue-wait-vs-execute attribution the record reports
+    next to client-measured e2e."""
 
-    def __init__(self, host, port, body, results, lock):
+    def __init__(self, host, port, body, results, lock, timings=None):
         self.conn = http.client.HTTPConnection(host, port, timeout=60)
         self.body = body
         self.results = results        # list of (status, latency_s)
         self.lock = lock
+        self.timings = timings        # list of {span: ms} or None
 
     def one(self, deadline_ms=None):
         t0 = time.monotonic()
+        tm = None
         try:
             self.conn.request(
                 "POST", "/v1/flow", body=self.body,
@@ -112,6 +124,13 @@ class Client:
             resp = self.conn.getresponse()
             resp.read()
             status = resp.status
+            if self.timings is not None:
+                hdr = resp.getheader("X-Raft-Timings")
+                if hdr:
+                    try:
+                        tm = json.loads(hdr)
+                    except ValueError:
+                        tm = None
         except Exception:
             self.conn.close()
             self.conn = http.client.HTTPConnection(
@@ -119,6 +138,8 @@ class Client:
             status = -1
         with self.lock:
             self.results.append((status, time.monotonic() - t0))
+            if tm is not None:
+                self.timings.append(tm)
 
 
 def diff_prom(before, after):
@@ -238,6 +259,11 @@ def run_chaos_recovery(args, host, port, server, results, body, deadline_s):
     Returns (record, problems) — problems gate --smoke."""
     injected = dict(server.faults.injected)
     server.faults.disarm()
+    # end-of-storm artifact: crash/breaker dumps already happened live;
+    # this one guarantees a dump even for drills whose arms never kill
+    # the batcher or open the breaker (e.g. a pure NaN/latency storm)
+    if getattr(server, "_flight_dump", None) is not None:
+        server._flight_dump("chaos_drill")
     # clean probes reuse the storm body: they feed the breaker's
     # half-open probe slot and prove the engine answers again
     probe = Client(host, port, body, [], threading.Lock())
@@ -291,6 +317,33 @@ def run_chaos_recovery(args, host, port, server, results, body, deadline_s):
         "recovered_s": round(recovered_s, 3) if recovered_s else None,
     }
     problems = []
+    # the incident-artifact half of the drill: faults fired, so the
+    # flight recorder must have dumped (batcher crash / breaker open) and
+    # the dump must carry the storm's error traces — under sampling too,
+    # because error traces are always retained
+    fire_count = sum(injected.values())
+    fp = getattr(server.sconfig, "flightrec_path", None)
+    if fp and os.path.exists(fp):
+        frecs = []
+        for ln in open(fp):
+            try:
+                frecs.append(json.loads(ln))
+            except json.JSONDecodeError:
+                pass
+        err_traces = [r for r in frecs if r.get("event") == "trace"
+                      and r.get("status") not in (None, "ok")]
+        rec["flightrec"] = {
+            "path": fp, "records": len(frecs),
+            "error_traces": len(err_traces),
+            "dump_reasons": sorted({r.get("reason") for r in frecs
+                                    if r.get("event") == "flightrec_dump"}),
+        }
+        if fire_count and not err_traces:
+            problems.append("chaos faults fired but the flight-recorder "
+                            "dump holds no error-status trace")
+    elif fire_count and getattr(server, "flightrec", None) is not None:
+        problems.append(f"chaos faults fired but no flight-recorder dump "
+                        f"at {fp} — no incident artifact")
     if statuses.get("-1"):
         problems.append(f"{statuses['-1']} dropped/errored connection(s) "
                         f"under chaos")
@@ -314,12 +367,12 @@ def run_chaos_recovery(args, host, port, server, results, body, deadline_s):
     return rec, problems
 
 
-def run_closed(host, port, body, clients, total):
+def run_closed(host, port, body, clients, total, timings=None):
     results, lock = [], threading.Lock()
     remaining = [total]
 
     def worker():
-        c = Client(host, port, body, results, lock)
+        c = Client(host, port, body, results, lock, timings=timings)
         while True:
             with lock:
                 if remaining[0] <= 0:
@@ -336,7 +389,7 @@ def run_closed(host, port, body, clients, total):
     return results, time.monotonic() - t0
 
 
-def run_open(host, port, body, clients, total, rate, seed=0):
+def run_open(host, port, body, clients, total, rate, seed=0, timings=None):
     """Poisson arrivals at ``rate`` req/s; a slot queue of worker threads
     sends them.  If every worker is busy when an arrival fires, it waits —
     the server's own queue/shedding is what we're measuring, so workers
@@ -346,7 +399,7 @@ def run_open(host, port, body, clients, total, rate, seed=0):
     jobs = _q.Queue()
 
     def worker():
-        c = Client(host, port, body, results, lock)
+        c = Client(host, port, body, results, lock, timings=timings)
         while True:
             item = jobs.get()
             if item is None:
@@ -370,6 +423,98 @@ def run_open(host, port, body, clients, total, rate, seed=0):
     for t in threads:
         t.join()
     return results, time.monotonic() - t0
+
+
+def _timings_summary(timings):
+    """Per-span p50/p95 (ms) over the collected X-Raft-Timings headers —
+    the server's own attribution next to the client's e2e numbers."""
+    if not timings:
+        return None
+    out = {}
+    for name in ("admit", "queue_wait", "batch_form", "pad", "execute",
+                 "execute_dispatch", "execute_block"):
+        vals = sorted(t[name] for t in timings if name in t)
+        if vals:
+            out[name] = {
+                "p50": round(float(np.percentile(vals, 50)), 3),
+                "p95": round(float(np.percentile(vals, 95)), 3),
+            }
+    return out or None
+
+
+def fetch_trace_accounting(host, port, settle_s=5.0):
+    """GET /debug/traces and audit the span accounting: for every
+    completed ok trace, the top-level spans (admit + queue_wait +
+    batch_form + pad + execute + respond) must cover ~all of the
+    server-side e2e (the root `request` span) — the proof that the
+    attribution is honest, not decorative.  Returns (record, problems).
+
+    A trace finishes AFTER its response bytes go out, so the last
+    client's read can race the handler's closing statements — poll until
+    ``open_traces`` settles at 0 (a real leak stays nonzero past the
+    window and still fails)."""
+    deadline = time.monotonic() + settle_s
+    while True:
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("GET", "/debug/traces")
+        resp = conn.getresponse()
+        payload = json.loads(resp.read())
+        conn.close()
+        if resp.status != 200:
+            return None, [f"/debug/traces answered {resp.status}"]
+        if not payload.get("open_traces") or time.monotonic() > deadline:
+            break
+        time.sleep(0.05)
+    coverages, dispatch_seen, block_seen = [], 0, 0
+    for tr in payload.get("traces", []):
+        spans = tr.get("spans", [])
+        root = next((s for s in spans if s["name"] == "request"), None)
+        if root is None or not root.get("dur_ms"):
+            continue
+        for s in spans:
+            dispatch_seen += s["name"] == "execute_dispatch"
+            block_seen += s["name"] == "execute_block"
+        if tr.get("status") != "ok":
+            continue
+        top = sum(s.get("dur_ms", 0.0) for s in spans
+                  if s.get("parent") == root["span"])
+        coverages.append(top / root["dur_ms"])
+    rec = {
+        "open_traces": payload.get("open_traces"),
+        "finished": payload.get("finished"),
+        "retained_ok": payload.get("retained_ok"),
+        "retained_error": payload.get("retained_error"),
+        "ok_traces_audited": len(coverages),
+        "span_coverage_min": round(min(coverages), 4) if coverages else None,
+        "span_coverage_mean": round(sum(coverages) / len(coverages), 4)
+        if coverages else None,
+    }
+    problems = []
+    if payload.get("open_traces"):
+        problems.append(f"{payload['open_traces']} trace(s) still open "
+                        f"after the run — leaked spans")
+    if not coverages:
+        problems.append("no completed ok traces to audit on /debug/traces")
+    else:
+        # the MEAN is the accounting criterion; the per-request floor is
+        # deliberately loose — on a loaded 2-core CI box one thread
+        # wake-up hiccup can dent a single short request by ~20% without
+        # anything being untracked (a missing span CLASS drops coverage
+        # far below it on every request)
+        if rec["span_coverage_mean"] < 0.95:
+            problems.append(
+                f"span accounting covers only "
+                f"{rec['span_coverage_mean']:.0%} of e2e on average "
+                f"(>= 95% required: time is going somewhere untracked)")
+        if rec["span_coverage_min"] < 0.75:
+            problems.append(
+                f"a request's spans cover only "
+                f"{rec['span_coverage_min']:.0%} of its e2e (>= 75% "
+                f"floor)")
+    if not dispatch_seen or not block_seen:
+        problems.append("execute_dispatch/execute_block spans missing — "
+                        "device time is not split dispatch vs block")
+    return rec, problems
 
 
 def _iters_summary(prom_diff):
@@ -429,6 +574,28 @@ def run_video_bench(args, host, port, server, config) -> int:
     # previous frame (1 more); the pairwise arm costs 2 fnet passes per
     # pair on the same frames
     fnet_passes = advances + opens + misses
+    # the stream-path device-step families (the occupancy gap ROADMAP
+    # item 1 calls out): step time + batch/occupancy — the measured
+    # batch-1 baseline continuous stream batching has to beat
+    step_count = int(stream_d.get("raft_stream_step_seconds_count", 0))
+    step_stats = None
+    if step_count:
+        occ_cnt = stream_d.get("raft_stream_step_occupancy_count", 0)
+        step_stats = {
+            "count": step_count,
+            "mean_ms": round(
+                stream_d.get("raft_stream_step_seconds_sum", 0.0)
+                / step_count * 1000.0, 3),
+            "p95_s": hist_percentile(stream_d,
+                                     "raft_stream_step_seconds", 0.95),
+            "batch_mean": round(
+                stream_d.get("raft_stream_step_batch_sum", 0.0)
+                / max(1, stream_d.get("raft_stream_step_batch_count", 0)),
+                3),
+            "occupancy_mean": round(
+                stream_d.get("raft_stream_step_occupancy_sum", 0.0)
+                / occ_cnt, 3) if occ_cnt else None,
+        }
     stream_rec = phase(stream_res, stream_s, stream_d)
     stream_rec.update({
         "sessions": sessions,
@@ -439,6 +606,7 @@ def run_video_bench(args, host, port, server, config) -> int:
         "encoder_passes_saved_pct": round(
             100.0 * (1.0 - fnet_passes / (2.0 * advances)), 1)
         if advances else None,
+        "device_steps": step_stats,
     })
     rec = {
         "bench": "serving", "mode": "video",
@@ -469,6 +637,9 @@ def run_video_bench(args, host, port, server, config) -> int:
         if not hits:
             problems.append("no fnet cache hits: streamed advances never "
                             "reused the previous frame's features")
+        if not args.url and step_stats is None:
+            problems.append("raft_stream_step_seconds never observed — "
+                            "the stream-path step histograms are dead")
         if rec["compile_misses_after_warmup"] != 0:
             problems.append(f"{rec['compile_misses_after_warmup']} "
                             f"compile(s) after warmup")
@@ -516,6 +687,12 @@ def main() -> int:
                         "'converge:eps[:min_iters]'); per-request "
                         "iterations-used p50/p95 land in the output "
                         "record from the raft_iters_used histogram")
+    p.add_argument("--trace-sample", type=float, default=None, metavar="P",
+                   help="in-process server: request-trace retention "
+                        "fraction (ServeConfig.trace_sample; default 1, "
+                        "0 disables tracing).  The smoke also runs an "
+                        "untraced control phase and asserts the tracing "
+                        "overhead stays under 5%% pairs/s")
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--out", default="BENCH_serving.json")
     p.add_argument("--video", action="store_true",
@@ -615,8 +792,17 @@ def main() -> int:
         # return-to-healthy in seconds, not the production 30s window
         robustness = {}
         if args.chaos:
+            import tempfile
             robustness = dict(chaos=args.chaos, breaker_cooldown_s=2.0,
-                              degraded_window_s=2.0)
+                              degraded_window_s=2.0,
+                              # every drill must leave an artifact: the
+                              # flight recorder dumps here on batcher
+                              # crash / breaker open, and the audit below
+                              # asserts the dump exists and carries the
+                              # faults' error traces
+                              flightrec_path=os.path.join(
+                                  tempfile.mkdtemp(prefix="raft_bench_"),
+                                  "flightrec.jsonl"))
             # every fault storm doubles as a race hunt: arm the runtime
             # lock-order validator (telemetry/watchdogs.py) before the
             # server constructs its locks; the drill asserts zero
@@ -628,6 +814,8 @@ def main() -> int:
             default_deadline_ms=args.deadline_ms, port=0,
             iters_policy=args.iters_policy,
             max_sessions=args.max_sessions if args.video else 0,
+            trace_sample=(1.0 if args.trace_sample is None
+                          else args.trace_sample),
             **robustness)
         server = FlowServer(config, params, sconfig, verbose=False)
         t0 = time.monotonic()
@@ -641,12 +829,60 @@ def main() -> int:
         return run_video_bench(args, host, port, server,
                                None if args.url else config)
 
-    if args.mode == "closed":
-        results, elapsed = run_closed(host, port, body,
-                                      args.clients, args.requests)
-    else:
-        results, elapsed = run_open(host, port, body, args.clients,
-                                    args.requests, args.rate)
+    def drive(timings=None):
+        """One load phase under the selected loop mode — the overhead
+        control below MUST drive the same way as the measured phase."""
+        if args.mode == "closed":
+            return run_closed(host, port, body, args.clients,
+                              args.requests, timings=timings)
+        return run_open(host, port, body, args.clients, args.requests,
+                        args.rate, timings=timings)
+
+    # tracing-overhead control (the < 5% pairs/s contract): an UNTRACED
+    # phase first — same load, tracer muted — so the measured (traced) run
+    # gets the warmer caches, biasing the comparison against a false FAIL
+    overhead = None
+    if (args.smoke and server is not None and not args.chaos
+            and server.tracer.sample > 0):
+        saved_sample = server.tracer.sample
+        server.tracer.sample = 0.0
+        base_res, base_elapsed = drive()
+        server.tracer.sample = saved_sample
+        base_ok = sum(1 for st, _ in base_res if st == 200)
+        overhead = {"untraced_pairs_per_sec":
+                    round(base_ok / base_elapsed, 3) if base_elapsed
+                    else 0.0}
+
+    timings = []
+    results, elapsed = drive(timings=timings)
+
+    # span accounting audit (before shutdown dumps disturb the ring):
+    # every request's spans must sum to ~its e2e, and none may leak open
+    accounting, accounting_problems = None, []
+    if args.smoke and server is not None and not args.chaos \
+            and server.tracer.sample > 0:
+        accounting, accounting_problems = fetch_trace_accounting(host, port)
+
+    # finish the overhead comparison while the server is still alive:
+    # two short phases on a shared 2-core runner can differ by > 5% from
+    # scheduler noise alone, so an apparent failure re-measures the
+    # traced arm once — a genuine regression fails both times
+    if overhead is not None:
+        traced_ok = sum(1 for st, _ in results if st == 200)
+        traced_pps = round(traced_ok / elapsed, 3) if elapsed else 0.0
+        base = overhead["untraced_pairs_per_sec"]
+        pct = (1.0 - traced_pps / base) * 100.0 if base else None
+        if pct is not None and pct >= 5.0:
+            retry_res, retry_elapsed = drive()
+            ok2 = sum(1 for st, _ in retry_res if st == 200)
+            pps2 = round(ok2 / retry_elapsed, 3) if retry_elapsed else 0.0
+            overhead["retried"] = True
+            if pps2 > traced_pps:
+                traced_pps = pps2
+                pct = (1.0 - traced_pps / base) * 100.0
+        overhead["traced_pairs_per_sec"] = traced_pps
+        overhead["overhead_pct"] = (round(pct, 2) if pct is not None
+                                    else None)
 
     # chaos drill: storm is over — disarm, recover, audit (server alive)
     chaos_rec, chaos_problems = None, []
@@ -722,6 +958,16 @@ def main() -> int:
             "p50": hist_percentile(prom, "raft_iters_used", 0.50),
             "p95": hist_percentile(prom, "raft_iters_used", 0.95),
         }
+    # server-side latency attribution (meta.timings / X-Raft-Timings):
+    # queue wait vs device execute p95 next to the client's e2e p95 — the
+    # number that says whether a slow p95 is a queueing or a compute story
+    ts = _timings_summary(timings)
+    if ts is not None:
+        rec["server_timings_ms"] = ts
+    if overhead is not None:         # computed above, pre-shutdown
+        rec["trace_overhead"] = overhead
+    if accounting is not None:
+        rec["trace_accounting"] = accounting
     if chaos_rec is not None:
         chaos_rec["fault_injected_total"] = {
             k.split("=")[-1].strip('"}'): int(v) for k, v in prom.items()
@@ -757,8 +1003,19 @@ def main() -> int:
 
     if args.smoke or chaos_problems:
         problems = list(chaos_problems)
+        problems.extend(accounting_problems)
         if not ok_lat:
             problems.append("no successful requests")
+        if overhead is not None and overhead.get("overhead_pct") is not None \
+                and overhead["overhead_pct"] >= 5.0:
+            problems.append(
+                f"tracing costs {overhead['overhead_pct']:.1f}% pairs/s "
+                f"vs --trace-sample 0 (>= 5%: tracing must be ~free)")
+        if args.smoke and server is not None and not args.chaos \
+                and server.tracer.sample > 0 and ts is None:
+            problems.append("no X-Raft-Timings headers collected — the "
+                            "server-side breakdown never reached the "
+                            "client")
         if rec["batch_size_mean"] <= 1.0 and args.clients > 1:
             problems.append(f"batcher never coalesced "
                             f"(mean batch {rec['batch_size_mean']})")

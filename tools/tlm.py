@@ -14,12 +14,20 @@ Usage:
     python tools/tlm.py tail PATH [-n N]
     python tools/tlm.py summary PATH
     python tools/tlm.py compare A B
+    python tools/tlm.py trace PATH [TRACE_ID]
 
 ``summary`` prints the manifest (provenance: git sha, jax version, device,
 config hash), per-event-kind counts, and whatever run result the log holds
-(final metric snapshot, step trajectory, bench headline).  ``compare``
-diffs two runs field-by-field: manifest provenance first (did the commit /
-config / device change?), then the numeric results.
+(final metric snapshot, step trajectory, bench headline) — plus, when the
+log carries request traces, a latency-attribution table (queue_wait vs
+execute vs respond p50/p95 and their share of e2e).  ``compare`` diffs two
+runs field-by-field: manifest provenance first (did the commit / config /
+device change?), then the numeric results.  ``trace`` works on any stream
+holding ``{"event": "trace", ...}`` records — a serve run's
+``events.jsonl`` or a flight-recorder dump (``flightrec.jsonl``,
+``GET /debug/traces`` saved to a file): without an id it lists the traces
+(slowest / non-ok first); with one (a prefix is enough) it renders the
+span tree as a waterfall.
 
 Pure stdlib and importable — no jax required, so it runs in the lint-tier
 CI job and on a laptop without the training environment.
@@ -44,10 +52,11 @@ def load_records(path) -> List[dict]:
     p = Path(path)
     if p.is_dir():
         # a run output dir (--out): merge the event log with the training
-        # metrics stream(s) one level down, so one `tlm summary <out>` sees
-        # both the provenance and the step trajectory
+        # metrics stream(s) one level down — and any flight-recorder dump
+        # (serve runs) — so one `tlm summary <out>` sees everything
         streams = [q for q in
-                   [p / "events.jsonl", p / "metrics.jsonl"]
+                   [p / "events.jsonl", p / "metrics.jsonl",
+                    p / "flightrec.jsonl"]
                    + sorted(p.glob("*/metrics.jsonl")) if q.exists()]
         if not streams:
             raise FileNotFoundError(
@@ -113,9 +122,18 @@ def summary_lines(path) -> List[str]:
             if k in man:
                 out.append(f"  {k:<14} {man.get(k)}")
     kinds = {}
+    seen_trace_ids = set()
     for rec in records:
-        kinds[rec.get("event", "record")] = \
-            kinds.get(rec.get("event", "record"), 0) + 1
+        kind = rec.get("event", "record")
+        if kind == "trace" and isinstance(rec.get("spans"), list):
+            # a run-dir load merges events.jsonl with the flightrec dump;
+            # count each trace once (same dedup as trace_records)
+            tid = rec.get("trace_id")
+            if tid is not None:
+                if tid in seen_trace_ids:
+                    continue
+                seen_trace_ids.add(tid)
+        kinds[kind] = kinds.get(kind, 0) + 1
     out.append("  events: " + ", ".join(f"{k}={n}"
                                         for k, n in sorted(kinds.items())))
     steps = _step_records(records)
@@ -150,6 +168,7 @@ def summary_lines(path) -> List[str]:
         if rec.get("event") == "recompile":
             out.append(f"  RECOMPILE #{rec.get('n')} at stage "
                        f"{rec.get('stage')!r} ({rec.get('duration_s')}s)")
+    out.extend(attribution_lines(records))
     # bench-style single objects: surface the headline numbers
     for rec in records:
         if "value" in rec and "metric" in rec:
@@ -163,6 +182,124 @@ def summary_lines(path) -> List[str]:
                         f"{row['pairs_per_sec']} pairs/s  "
                         f"mean_iters {row['mean_iters']} "
                         f"(fixed {conv.get('baseline_mean_iters')})")
+    return out
+
+
+# ------------------------------------------------------- request traces --
+
+SPAN_ORDER = ("admit", "queue_wait", "batch_form", "pad", "execute",
+              "execute_dispatch", "execute_block", "respond")
+
+
+def trace_records(records: List[dict]) -> List[dict]:
+    """The request-trace records in a stream (events.jsonl `trace` events
+    and flight-recorder dumps share one shape).  Deduplicated by trace id:
+    a default serve run writes each trace to BOTH events.jsonl and the
+    flightrec dump, and a run-dir load merges the two."""
+    out: dict = {}
+    for r in records:
+        if r.get("event") == "trace" and isinstance(r.get("spans"), list):
+            out[r.get("trace_id") or id(r)] = r
+    return list(out.values())
+
+
+def _pctl(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+def attribution_lines(records: List[dict]) -> List[str]:
+    """The latency-attribution table: per span name, p50/p95 of the
+    per-trace total and its share of mean e2e — where the time went,
+    fleet-wide (`tlm trace <id>` for one request's waterfall)."""
+    traces = trace_records(records)
+    if not traces:
+        return []
+    per: dict = {}
+    e2e = []
+    for rec in traces:
+        e2e.append(float(rec.get("dur_ms") or 0.0))
+        sums: dict = {}
+        for s in rec["spans"]:
+            if s.get("name") == "request":
+                continue
+            sums[s["name"]] = sums.get(s["name"], 0.0) + s.get("dur_ms", 0.0)
+        for k, v in sums.items():
+            per.setdefault(k, []).append(v)
+    mean_e2e = sum(e2e) / len(e2e) if e2e else 0.0
+    by_status: dict = {}
+    for rec in traces:
+        st = rec.get("status", "?")
+        by_status[st] = by_status.get(st, 0) + 1
+    out = [f"  latency attribution over {len(traces)} trace(s) "
+           f"(" + ", ".join(f"{k}={n}" for k, n in sorted(by_status.items()))
+           + f"), mean e2e {mean_e2e:.2f}ms:"]
+    names = [n for n in SPAN_ORDER if n in per]
+    names += sorted(set(per) - set(SPAN_ORDER))
+    for name in names:
+        vals = sorted(per[name])
+        share = (sum(vals) / len(traces)) / mean_e2e * 100 if mean_e2e else 0
+        nested = name in ("execute_dispatch", "execute_block")
+        out.append(f"    {name:<18} p50 {_pctl(vals, 0.50):9.2f}ms  "
+                   f"p95 {_pctl(vals, 0.95):9.2f}ms  "
+                   f"{share:5.1f}% of e2e"
+                   + ("  (inside execute)" if nested else ""))
+    return out
+
+
+def trace_list_lines(records: List[dict]) -> List[str]:
+    traces = trace_records(records)
+    if not traces:
+        return ["no trace records found (serve with --trace-sample > 0, "
+                "or point at a flightrec.jsonl dump)"]
+    # non-ok first, then slowest: the ones worth looking at
+    traces.sort(key=lambda r: (r.get("status") == "ok",
+                               -(r.get("dur_ms") or 0.0)))
+    out = [f"{len(traces)} trace(s)  (tlm trace PATH <id-prefix> for the "
+           f"waterfall)"]
+    for r in traces:
+        out.append(f"  {r.get('trace_id', '?')[:16]:<16} "
+                   f"[{r.get('kind', '?'):<6}] "
+                   f"{r.get('status', '?'):<9} "
+                   f"{r.get('dur_ms', 0.0):9.2f}ms  "
+                   f"{len(r.get('spans', [])):3d} span(s)")
+    return out
+
+
+def render_trace(rec: dict, width: int = 36) -> List[str]:
+    """One trace as an indented span tree + waterfall (start offsets and
+    durations in ms; co-batched requests share the execute span id)."""
+    spans = rec.get("spans", [])
+    total = max([rec.get("dur_ms") or 0.0]
+                + [s.get("start_ms", 0.0) + s.get("dur_ms", 0.0)
+                   for s in spans]) or 1e-9
+    by_parent: dict = {}
+    for s in spans:
+        by_parent.setdefault(s.get("parent"), []).append(s)
+    out = [f"trace {rec.get('trace_id')} [{rec.get('kind')}] "
+           f"status={rec.get('status')} {rec.get('dur_ms')}ms "
+           f"({len(spans)} span(s))"]
+
+    def emit(s: dict, depth: int) -> None:
+        start, dur = s.get("start_ms", 0.0), s.get("dur_ms", 0.0)
+        a = int(start / total * width)
+        b = max(a + 1, int((start + dur) / total * width))
+        bar = "·" * a + "█" * (b - a)
+        flag = ("" if s.get("status") in ("ok", None)
+                else f"  !{s['status']}")
+        label = "  " * depth + s.get("name", "?")
+        out.append(f"  {label:<22} {start:9.2f} {dur:9.2f}ms  "
+                   f"|{bar:<{width}}|{flag}")
+        kids = sorted(by_parent.get(s.get("span"), []),
+                      key=lambda c: c.get("start_ms", 0.0))
+        for c in kids:
+            emit(c, depth + 1)
+
+    for root in sorted(by_parent.get(None, []),
+                       key=lambda c: c.get("start_ms", 0.0)):
+        emit(root, 0)
     return out
 
 
@@ -242,6 +379,12 @@ def main(argv=None) -> int:
     pc = sub.add_parser("compare", help="diff two runs with provenance")
     pc.add_argument("a")
     pc.add_argument("b")
+    pr = sub.add_parser("trace", help="list request traces / render one "
+                                      "as a span-tree waterfall")
+    pr.add_argument("path", help="events.jsonl, flightrec.jsonl, or a "
+                                 "run dir holding one")
+    pr.add_argument("trace_id", nargs="?", default=None,
+                    help="trace id (prefix ok); omit to list")
     args = p.parse_args(argv)
 
     try:
@@ -250,6 +393,21 @@ def main(argv=None) -> int:
                 print(json.dumps(rec))
         elif args.cmd == "summary":
             print("\n".join(summary_lines(args.path)))
+        elif args.cmd == "trace":
+            records = load_records(args.path)
+            if args.trace_id is None:
+                print("\n".join(trace_list_lines(records)))
+                return 0 if trace_records(records) else 1
+            # stored ids are lowercase; accept the prefix in any case
+            want = args.trace_id.lower()
+            hits = [r for r in trace_records(records)
+                    if str(r.get("trace_id", "")).startswith(want)]
+            if not hits:
+                print(f"tlm: no trace matching {args.trace_id!r} in "
+                      f"{args.path}", file=sys.stderr)
+                return 1
+            for rec in hits:
+                print("\n".join(render_trace(rec)))
         else:
             lines, comparable = compare_lines(args.a, args.b)
             print("\n".join(lines))
@@ -257,6 +415,8 @@ def main(argv=None) -> int:
     except FileNotFoundError as e:
         print(f"tlm: {e}", file=sys.stderr)
         return 2
+    except BrokenPipeError:       # `tlm trace ... | head` is a normal use
+        return 0
     return 0
 
 
