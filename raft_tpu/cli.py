@@ -201,6 +201,38 @@ def _build_parser() -> argparse.ArgumentParser:
                         "newest file with a warning instead of crashing")
     p.add_argument("--log-every", type=int, default=None, metavar="N",
                    help="train mode: metrics.jsonl/console logging period")
+    p.add_argument("--async-ckpt", dest="async_ckpt", action="store_true",
+                   default=None,
+                   help="train mode: checkpoint through the background "
+                        "writer thread — the step loop snapshots to host "
+                        "and never blocks on serialization/fsync/verify "
+                        "(the default; training/resilience.py)")
+    p.add_argument("--sync-ckpt", dest="async_ckpt", action="store_false",
+                   help="train mode: historical inline checkpointing — the "
+                        "step loop blocks for the whole write (bit-for-bit "
+                        "today's behavior; disables the async verify pass)")
+    p.add_argument("--max-rollbacks", type=int, default=None, metavar="N",
+                   help="train mode: divergence rollback budget — a "
+                        "non-finite loss/grad-norm at any step restores the "
+                        "last finite checkpoint snapshot and skips past the "
+                        "offending data window, aborting after N "
+                        "CONSECUTIVE rollbacks (default 3; 0 disables and "
+                        "restores the halt-after-3-logged-steps behavior)")
+    p.add_argument("--worker-respawns", type=int, default=None, metavar="N",
+                   help="train mode, with --workers: respawn budget for "
+                        "dead/stalled data workers — the pool is rebuilt "
+                        "(shm slots reclaimed, queues replaced) up to N "
+                        "times per 2-minute window before the loader "
+                        "escalates to the historical error (default 3; "
+                        "0 = fail fast)")
+    p.add_argument("--chaos-train", default=None, metavar="SPEC",
+                   help="train mode: arm the training-plane fault injector "
+                        "(training/faults.py; env RAFT_TPU_CHAOS_TRAIN), "
+                        "e.g. 'seed=5,worker_kill=0.02,worker_stall=0.01,"
+                        "nan_loss=0.05,torn_ckpt=0.5,preempt=40' — rates "
+                        "per arm, preempt takes the step at which SIGTERM "
+                        "is self-delivered; tools/train_chaos.py is the "
+                        "scripted drill")
     p.add_argument("--train-size", type=int, nargs=2, default=None,
                    metavar=("H", "W"),
                    help="training crop size (default: the stage preset's "

@@ -268,6 +268,20 @@ class TrainConfig:
     # Resume pairs with this: restore_latest_with_fallback skips a
     # corrupt/truncated newest file instead of crashing.
     keep_checkpoints: Optional[int] = None
+    # Async checkpointing (training/resilience.py): the step loop snapshots
+    # device state to host at the step boundary and hands it to a bounded
+    # background writer — serialization, fsync, verify-after-write and
+    # retention pruning never block a step.  False (--sync-ckpt) restores
+    # the historical inline save, bit-for-bit.
+    async_checkpointing: bool = True
+    # Divergence rollback: a non-finite loss/grad-norm at any step restores
+    # the last finite checkpoint snapshot, re-randomizes the PRNG stream
+    # (retry count folded into the key) and continues past the offending
+    # data window; the run aborts after this many CONSECUTIVE rollbacks.
+    # 0 disables (the halt_on_nonfinite streak logic applies instead), as
+    # does halt_on_nonfinite=False (the explicit ride-through opt-out).
+    # Single-host only — under multi-host training the sentinel is off.
+    max_rollbacks: int = 3
 
     @staticmethod
     def for_stage(stage: str, **overrides) -> "TrainConfig":

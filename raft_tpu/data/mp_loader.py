@@ -46,23 +46,46 @@ Design notes:
   both transports.
 * bounded task/result queues — backpressure instead of unbounded buffering
   (multiprocessing.Pool.imap would eagerly drain the infinite index stream).
+* self-healing — a dead (OOM-killed, segfaulted) or stalled worker pool is
+  **respawned** instead of aborting the run, up to ``max_respawns`` events
+  per ``respawn_window_s`` (then the historical error raises, now carrying
+  per-worker exitcodes + the shm free-list depth so postmortems can tell
+  an OOM kill from a deadlock).  A respawn quiesces the whole pool and
+  rebuilds the mp queues from scratch — a worker killed mid-``put`` can
+  leave a queue's shared pipe lock held forever, so the old queues are
+  unsalvageable by construction — salvages already-finished samples,
+  reclaims the shm slots the dead workers held (free-list reconciliation:
+  every slot not referenced by a salvaged sample or the consumer's pending
+  view returns to the ring), and restarts deterministically-seeded workers
+  (task seeds are content seeds, so reproducibility survives the respawn).
+  Counted in ``raft_data_worker_respawns_total``; with ``epochs`` set, the
+  tasks in flight at the kill are lost and the epoch under-delivers — the
+  stall detector then escalates, which is the intended bound.
 """
 
 from __future__ import annotations
 
 import itertools
 import multiprocessing as mp
+import os
 import queue
+import signal
 import threading
 import time
 import traceback
+from collections import deque
+from multiprocessing import connection as mp_connection
 from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..telemetry.log import get_logger
 from ..telemetry.registry import default_registry
 
+_log = get_logger("data")
+
 _SENTINEL = None
+_STALL = "__stall__"
 _SLOT_ALIGN = 64
 
 
@@ -81,6 +104,9 @@ def _loader_metrics():
         "free_slots": reg.get_or_gauge(
             "raft_data_shm_free_slots",
             "Shared-memory transport: slots currently on the free list"),
+        "respawns": reg.get_or_counter(
+            "raft_data_worker_respawns_total",
+            "Worker-pool respawns healing a dead or stalled worker"),
     }
 
 
@@ -209,6 +235,11 @@ def _worker_loop(dataset, tasks, results, shm=None):
         task = tasks.get()
         if task is _SENTINEL:
             break
+        if isinstance(task, tuple) and task[0] == _STALL:
+            # injected stall (chaos arm worker_stall): alive but silent —
+            # exactly the deadlock signature the stall detector heals
+            time.sleep(float(task[1]))
+            continue
         idx, sample_seed = task
         try:
             aug = getattr(dataset, "augmentor", None)
@@ -245,7 +276,10 @@ class MPSampleLoader:
                  start_method: str = "forkserver",
                  transport: str = "pickle",
                  shm_slots: Optional[int] = None,
-                 sample_spec: Optional[SampleSpec] = None):
+                 sample_spec: Optional[SampleSpec] = None,
+                 faults=None,
+                 max_respawns: int = 3,
+                 respawn_window_s: float = 120.0):
         assert num_workers >= 1
         if start_method not in ("fork", "forkserver", "spawn"):
             raise ValueError(f"start_method must be fork/forkserver/spawn, "
@@ -256,14 +290,21 @@ class MPSampleLoader:
         self._stall_timeout = stall_timeout
         self._start_method = start_method
         self._transport = transport
-        ctx = mp.get_context(start_method)
-        depth = queue_depth or 2 * num_workers
+        self._dataset = dataset
+        self._num_workers = num_workers
+        self._faults = faults                 # training.faults injector or None
+        self._max_respawns = max_respawns     # 0 = historical fail-fast
+        self._respawn_window_s = respawn_window_s
+        self._respawn_times: deque = deque()
+        self._requeued: deque = deque()       # results salvaged over a respawn
+        self._pending_slot = None             # shm slot the consumer still views
+        self._ctx = ctx = mp.get_context(start_method)
+        self._depth = depth = queue_depth or 2 * num_workers
         self._tasks = ctx.Queue(maxsize=depth)
         self._results = ctx.Queue(maxsize=depth)
         self._ring = None
         self._free = None
         self._spec = None
-        shm_args = None
         if transport == "shm":
             self._spec = sample_spec or SampleSpec.from_sample(dataset[0])
             n_slots = shm_slots if shm_slots is not None \
@@ -275,20 +316,49 @@ class MPSampleLoader:
             self._free = ctx.Queue()
             for i in range(n_slots):
                 self._free.put(i)
-            shm_args = (self._ring.names, self._spec, self._free)
-        self._workers = [
-            ctx.Process(target=_worker_loop,
-                        args=(dataset, self._tasks, self._results, shm_args),
-                        daemon=True)
-            for _ in range(num_workers)]
-        for w in self._workers:
-            w.start()
+        self._workers = self._spawn_workers()
         self._closed = False
         self._n_tasks = (len(dataset) * epochs) if epochs is not None else None
         self._feeder = threading.Thread(
             target=self._feed, args=(dataset, seed, shuffle, epochs),
             daemon=True)
         self._feeder.start()
+
+    def _spawn_workers(self):
+        """Start a fresh worker generation bound to the CURRENT queues
+        (also the respawn path — self._tasks/_results/_free may be brand
+        new by then)."""
+        shm_args = None
+        if self._transport == "shm":
+            shm_args = (self._ring.names, self._spec, self._free)
+        workers = [
+            self._ctx.Process(target=_worker_loop,
+                              args=(self._dataset, self._tasks,
+                                    self._results, shm_args),
+                              daemon=True)
+            for _ in range(self._num_workers)]
+        for w in workers:
+            w.start()
+        # the exit-sentinel set the consumer polls for silent deaths; a
+        # worker that exits cleanly is dropped from it on first detection
+        self._sentinels = [w.sentinel for w in workers]
+        return workers
+
+    def _put_task(self, task) -> bool:
+        """Feeder-side put that can never wedge permanently: a worker
+        SIGKILLed inside ``tasks.get()`` dies HOLDING the queue's reader
+        lock, after which its items are unreachable and the bounded put's
+        semaphore can never be released — a plain blocking put would park
+        the feeder forever.  Retrying with a timeout re-reads
+        ``self._tasks`` each attempt, so the feeder migrates to the fresh
+        queue a respawn installed."""
+        while not self._closed:
+            try:
+                self._tasks.put(task, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _feed(self, dataset, seed, shuffle, epochs):
         rng = np.random.RandomState(seed)
@@ -303,49 +373,88 @@ class MPSampleLoader:
                                + int(idx)) % (2**31)
                 if self._closed:
                     return
-                self._tasks.put((int(idx), sample_seed))
-        for _ in self._workers:
-            self._tasks.put(_SENTINEL)
+                if (self._faults is not None
+                        and self._faults.roll("worker_stall")):
+                    # every worker draws one stall task and goes silent for
+                    # longer than the stall window — the consumer's detector
+                    # must heal the pool, not hang
+                    dur = (self._stall_timeout or 2.0) * 1.5 + 1.0
+                    for _ in range(self._num_workers):
+                        self._put_task((_STALL, dur))
+                if not self._put_task((int(idx), sample_seed)):
+                    return
+        for _ in range(self._num_workers):
+            self._put_task(_SENTINEL)
 
     def __iter__(self) -> Iterator:
         served = 0
         metrics = _loader_metrics()
         last_progress = time.monotonic()
-        pending_slot = None
         while self._n_tasks is None or served < self._n_tasks:
+            # chaos (training.faults worker_kill arm): SIGKILL one live
+            # worker — indistinguishable from the OOM killer downstream
+            if self._faults is not None and self._faults.roll("worker_kill"):
+                victims = [w for w in self._workers if w.is_alive()]
+                if victims:
+                    os.kill(victims[self._faults.pick(len(victims))].pid,
+                            signal.SIGKILL)
             while True:
+                if self._requeued:
+                    # samples salvaged from the pre-respawn result queue
+                    status, payload = self._requeued.popleft()
+                    last_progress = time.monotonic()
+                    break
+                # a worker killed by the OS (segfault, OOM killer) never
+                # queues an 'error' record — detect the death BEFORE
+                # draining the queue (a worker SIGKILLed mid-put leaves a
+                # torn frame whose recv would block forever), even while
+                # its siblings keep producing.  Per-sample cost is one
+                # poll(2) over the live workers' exit sentinels; the
+                # N-waitpid scan runs only when a sentinel actually fired
+                # (exitcode 0 = the normal end-of-epochs exit, never a
+                # failure — its sentinel is dropped from the polled set)
+                if self._sentinels and mp_connection.wait(self._sentinels,
+                                                          timeout=0):
+                    dead = [w for w in self._workers
+                            if not w.is_alive() and w.exitcode != 0]
+                    self._sentinels = [w.sentinel for w in self._workers
+                                       if w.is_alive()]
+                    if dead:
+                        self._heal_or_raise("death", metrics, dead=dead)
+                        last_progress = time.monotonic()
+                        continue
                 try:
                     status, payload = self._results.get(
                         timeout=self._poll_timeout)
                     last_progress = time.monotonic()
                     break
                 except queue.Empty:
-                    # a worker killed by the OS (segfault, OOM killer) never
-                    # queues an 'error' record — detect the silent death
-                    # instead of hanging the training job forever
-                    if not any(w.is_alive() for w in self._workers):
+                    if (self._n_tasks is not None
+                            and not self._feeder.is_alive()
+                            and not any(w.is_alive()
+                                        for w in self._workers)):
+                        # bounded run, feeder finished, every worker exited,
+                        # nothing queued: the remaining deficit can never
+                        # arrive (its tasks were lost with a respawn's torn
+                        # queues) — raise instead of polling forever
+                        diag = self._diagnostics()
                         self.close()
                         metrics["errors"].inc()
                         raise RuntimeError(
-                            "all data workers died without reporting (killed "
-                            "by the OS? check dmesg for OOM)") from None
-                    # ... and a DEADLOCKED worker is alive yet silent (e.g.
-                    # a fork taken while the parent's JAX/BLAS threads held
-                    # locks): raise instead of polling forever
+                            f"data pipeline under-delivered: {served}/"
+                            f"{self._n_tasks} samples served but the feeder "
+                            f"and every worker have exited (queued tasks "
+                            f"were lost when a respawn rebuilt the torn "
+                            f"queues); {diag}")
+                    # a DEADLOCKED worker is alive yet silent (e.g. a fork
+                    # taken while the parent's JAX/BLAS threads held locks):
+                    # heal — or raise once the respawn budget is spent —
+                    # instead of polling forever
                     stalled = time.monotonic() - last_progress
                     if (self._stall_timeout is not None
                             and stalled > self._stall_timeout):
-                        self.close()
-                        metrics["errors"].inc()
-                        hint = ("storage is stalled (raise stall_timeout / "
-                                "--stall-timeout, 0 disables)")
-                        if self._start_method == "fork":
-                            hint += (", or the fork deadlocked (threads held "
-                                     "locks at fork time; retry with "
-                                     "start_method='forkserver' or 'spawn')")
-                        raise RuntimeError(
-                            f"data workers alive but produced nothing for "
-                            f"{stalled:.0f}s — likely {hint}") from None
+                        self._heal_or_raise("stall", metrics, stalled=stalled)
+                        last_progress = time.monotonic()
             if status == "ready":
                 # worker finished cold start (the queue get above already
                 # reset the stall clock); nothing to serve yet
@@ -359,16 +468,179 @@ class MPSampleLoader:
             if self._transport == "shm":
                 # the consumer has moved past the previous sample (the
                 # copy-on-arrival contract): its slot goes back on the ring
-                if pending_slot is not None:
-                    self._free.put(pending_slot)
-                pending_slot = payload
+                if self._pending_slot is not None:
+                    self._free.put(self._pending_slot)
+                self._pending_slot = payload
                 metrics["free_slots"].set(self._free.qsize())
                 yield self._ring.views(self._spec, payload)
             else:
                 yield payload
-        if pending_slot is not None:
-            self._free.put(pending_slot)
+        if self._pending_slot is not None:
+            self._free.put(self._pending_slot)
+            self._pending_slot = None
         self.close()
+
+    # ------------------------------------------------- self-healing ------
+
+    def _diagnostics(self) -> str:
+        """Postmortem context for every loader failure and respawn line:
+        per-worker exitcodes (negative = killed by signal, e.g. -9 is the
+        OOM killer's SIGKILL; alive = deadlock candidate) and the shm
+        free-list depth (0 with live workers = slot leak or all-stuck)."""
+        codes = ", ".join(
+            f"pid {w.pid}={'alive' if w.is_alive() else w.exitcode}"
+            for w in self._workers)
+        s = f"worker exitcodes [{codes}]"
+        if self._ring is not None:
+            s += (f"; shm free-list depth {self._free.qsize()}"
+                  f"/{len(self._ring.shms)}")
+        return s
+
+    def _respawn_allowed(self) -> bool:
+        now = time.monotonic()
+        while (self._respawn_times
+               and now - self._respawn_times[0] > self._respawn_window_s):
+            self._respawn_times.popleft()
+        return len(self._respawn_times) < self._max_respawns
+
+    def _heal_or_raise(self, reason: str, metrics,
+                       dead=None, stalled: float = 0.0) -> None:
+        diag = self._diagnostics()
+        if self._n_tasks is not None and not self._feeder.is_alive():
+            # bounded run whose feeder already finished: the queued task
+            # tail dies with the torn queues and cannot be re-fed, so a
+            # respawned pool would starve forever — escalate instead of
+            # healing into a hang (endless training streams, epochs=None,
+            # always keep a live feeder and heal normally)
+            self.close()
+            metrics["errors"].inc()
+            raise RuntimeError(
+                f"data worker {reason} on a bounded run after the feeder "
+                f"finished; the remaining task queue was lost and cannot "
+                f"be re-fed, so the pool is not healable; {diag}") from None
+        if not self._respawn_allowed():
+            self.close()
+            metrics["errors"].inc()
+            if reason == "death":
+                raise RuntimeError(
+                    f"data worker(s) died without reporting (killed by the "
+                    f"OS? check dmesg for OOM) and the respawn budget "
+                    f"({self._max_respawns} per {self._respawn_window_s:.0f}s)"
+                    f" is spent; {diag}") from None
+            hint = ("storage is stalled (raise stall_timeout / "
+                    "--stall-timeout, 0 disables)")
+            if self._start_method == "fork":
+                hint += (", or the fork deadlocked (threads held "
+                         "locks at fork time; retry with "
+                         "start_method='forkserver' or 'spawn')")
+            raise RuntimeError(
+                f"data workers alive but produced nothing for "
+                f"{stalled:.0f}s — likely {hint}; respawn budget "
+                f"({self._max_respawns} per {self._respawn_window_s:.0f}s) "
+                f"is spent; {diag}") from None
+        self._respawn(reason, metrics, diag)
+
+    def _respawn(self, reason: str, metrics, diag: str) -> None:
+        """Quiesce the pool, salvage finished samples, reclaim shm slots,
+        rebuild the queues, restart the workers.
+
+        The queues must be REBUILT, not reused: a worker SIGKILLed inside
+        ``get()`` or mid-``put`` dies holding an mp.Queue's shared pipe
+        lock, wedging every later user.  The feeder's timeout-put retries
+        re-read ``self._tasks``, so it migrates to the fresh queue on its
+        own."""
+        self._respawn_times.append(time.monotonic())
+        for w in self._workers:
+            w.terminate()
+        for w in self._workers:
+            w.join(timeout=5)
+        # a worker that ignored/deferred SIGTERM (e.g. stalled in disk I/O
+        # — exactly the case the stall heal targets) must be SIGKILLed
+        # before its shm slot is reclaimed below: with SIGKILL pending it
+        # can never return to user space to write a buffer a fresh worker
+        # now owns
+        survivors = [w for w in self._workers if w.is_alive()]
+        for w in survivors:
+            w.kill()
+        for w in survivors:
+            w.join(timeout=5)
+        if self._ring is not None and any(w.is_alive()
+                                          for w in self._workers):
+            # unkillable (kernel-stuck) worker: its in-progress slot cannot
+            # be identified, so reclaiming the free list would risk two
+            # processes writing one buffer — fail loudly instead of
+            # corrupting training data silently
+            metrics["errors"].inc()
+            self.close()
+            raise RuntimeError(
+                f"data worker survived SIGKILL during a {reason} respawn "
+                f"(kernel-stuck?); shm slots cannot be safely reclaimed; "
+                f"{diag}")
+        # salvage finished results (decoded samples are too expensive to
+        # drop) AND worker 'error' reports — a genuine dataset/decode bug
+        # raised just before the respawn must still surface, not vanish
+        # with the old queue; a queue torn by the kill stops the salvage,
+        # never the heal
+        try:
+            while True:
+                status, payload = self._results.get_nowait()
+                if status in ("ok", "error"):
+                    self._requeued.append((status, payload))
+        except queue.Empty:
+            pass
+        except Exception:  # noqa: BLE001 — partial pickle from a torn pipe
+            pass
+        # fresh queues; the old ones may be poisoned beyond recovery (a
+        # worker SIGKILLed inside get() dies holding the reader lock, so
+        # queued items — and the bounded put semaphore — are lost).  The
+        # feeder's timeout-put (_put_task) migrates to the new task queue
+        # on its next retry; the old queue's tasks are lost, which an
+        # endless training stream never notices.
+        self._tasks = self._ctx.Queue(maxsize=self._depth)
+        self._results = self._ctx.Queue(maxsize=self._depth)
+        if self._ring is not None:
+            # free-list reconciliation: every slot not referenced by a
+            # salvaged sample or the consumer's pending view returns to the
+            # ring — including the slots the dead workers took before
+            # decoding and never published
+            held = {p for s, p in self._requeued if s == "ok"}
+            if self._pending_slot is not None:
+                held.add(self._pending_slot)
+            self._free = self._ctx.Queue()
+            for slot in range(len(self._ring.shms)):
+                if slot not in held:
+                    self._free.put(slot)
+            metrics["free_slots"].set(self._free.qsize())
+        self._workers = self._spawn_workers()
+        # absorb the new pool's cold start HERE (forkserver spawn + dataset
+        # unpickle can exceed a tight stall window, and a window that fires
+        # mid-spawn would kill every fresh generation in a loop): wait for
+        # each worker's ready beacon, salvaging anything that arrives
+        # interleaved, before the caller's stall clock restarts
+        deadline = time.monotonic() + 10.0
+        ready = 0
+        while ready < self._num_workers and time.monotonic() < deadline:
+            try:
+                status, payload = self._results.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            except Exception:  # noqa: BLE001
+                break
+            if status == "ready":
+                ready += 1
+            else:
+                self._requeued.append((status, payload))
+        metrics["respawns"].inc()
+        _log.warning(
+            f"respawned {self._num_workers} data worker(s) after {reason} "
+            f"({len(self._respawn_times)}/{self._max_respawns} in window); "
+            f"{diag}")
+        from ..telemetry import events as tlm_events
+        run_log = tlm_events.current()
+        if run_log is not None:
+            run_log.event("worker_respawn", reason=reason,
+                          diagnostics=diag,
+                          respawns_in_window=len(self._respawn_times))
 
     def close(self):
         if self._closed:

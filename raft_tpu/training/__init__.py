@@ -1,5 +1,7 @@
 from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
 from .loss import epe_metrics, sequence_loss
 from .optim import make_optimizer, make_schedule, one_cycle_schedule
+from .resilience import (PREEMPT_EXIT_CODE, CheckpointWriter,
+                         PreemptionGuard, TrainingPreempted)
 from .state import TrainState, merge_bn_state, split_bn_state
 from .step import Batch, make_eval_step, make_train_step

@@ -52,7 +52,14 @@ def _named_leaves(state) -> Dict[str, object]:
 
 
 def save_checkpoint(path, state, overwrite: bool = True) -> None:
-    """Serialize any pytree of arrays/scalars to a single npz."""
+    """Serialize any pytree of arrays/scalars to a single npz.
+
+    Durability: the temp file is fsync'd BEFORE the atomic rename and the
+    parent directory is fsync'd AFTER it — ``os.replace`` alone only
+    orders the rename against other metadata, so a power failure could
+    otherwise surface the new NAME pointing at unflushed DATA (or lose
+    the rename entirely).  ``checkpoint_readable`` stays the read-side
+    guard for files that travel."""
     path = Path(path)
     if path.exists() and not overwrite:
         raise FileExistsError(path)
@@ -63,10 +70,28 @@ def save_checkpoint(path, state, overwrite: bool = True) -> None:
     os.close(fd)
     try:
         np.savez(tmp, **arrays)
+        with open(tmp, "rb") as f:
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        _fsync_dir(path.parent)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+
+
+def _fsync_dir(dirpath) -> None:
+    """Flush a directory entry (the rename itself) to stable storage;
+    best-effort on platforms where directories can't be opened (Windows)."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def _restore_leaf(arr: np.ndarray, template_leaf, name: str, path) -> object:

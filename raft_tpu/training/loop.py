@@ -23,9 +23,10 @@ from ..telemetry import Registry, config_hash, run_manifest
 from ..telemetry import events as tlm_events
 from ..telemetry import watchdogs as tlm_watchdogs
 from ..telemetry.trace import TraceWindow, stage
-from .checkpoint import (prune_checkpoints, restore_latest_with_fallback,
-                         save_checkpoint)
+from .checkpoint import restore_latest_with_fallback
 from .optim import make_optimizer
+from .resilience import (PREEMPT_EXIT_CODE, CheckpointWriter, LastGood,
+                         PreemptionGuard, TrainingPreempted, save_if_finite)
 from .state import TrainState
 from .step import Batch, make_train_step
 
@@ -34,7 +35,7 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
           ckpt_dir: Optional[str] = None, resume: bool = True,
           data_parallel: bool = True, log_fn=print,
           trace_dir: Optional[str] = None, trace_steps: int = 4,
-          init_params: Optional[dict] = None) -> TrainState:
+          init_params: Optional[dict] = None, faults=None) -> TrainState:
     """Run the training loop over ``batch_iter`` yielding numpy
     (im1, im2, flow, valid) batches; returns the final state.
 
@@ -43,6 +44,17 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
     official curriculum chains stages (chairs -> things -> sintel/kitti).
     The optimizer starts fresh at step 0; a resumable checkpoint in
     ``ckpt_dir`` still takes precedence (continuation beats warm start).
+
+    ``faults``: an armed :class:`raft_tpu.training.faults.TrainFaultInjector`
+    (``--chaos-train``) or None — the zero-overhead off state.
+
+    Resilience (training/resilience.py): checkpoints go through an async
+    background writer by default (``tconfig.async_checkpointing``);
+    SIGTERM/SIGINT finish the in-flight step, write an emergency
+    checkpoint and raise :class:`TrainingPreempted` (CLI exit code
+    ``PREEMPT_EXIT_CODE``); a non-finite loss/grad-norm at any step rolls
+    back to the last finite checkpoint snapshot, up to
+    ``tconfig.max_rollbacks`` consecutive times.
     """
     tx = make_optimizer(tconfig)
     if init_params is None:
@@ -167,6 +179,21 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
                                "Checkpoints written this session")
     m_rate = registry.gauge("raft_train_steps_per_sec",
                             "Steady-state training throughput")
+    m_rollbacks = registry.counter(
+        "raft_train_rollbacks_total",
+        "Divergence rollbacks to the last good checkpoint snapshot")
+    m_ckpt_write = registry.histogram(
+        "raft_ckpt_write_seconds",
+        "Checkpoint serialize+fsync(+verify) wall time, writer-side")
+    m_ckpt_queue = registry.gauge(
+        "raft_ckpt_queue_depth",
+        "Checkpoints queued behind the async writer")
+    if faults is not None:
+        # registered only when armed, so a production run_end snapshot
+        # never carries the chaos family (same contract as serving)
+        faults.counter = registry.counter(
+            "raft_fault_injected_total",
+            "Training chaos-harness fires by arm", labelnames=("arm",))
 
     # opt-in watchdogs (RAFT_TPU_WATCHDOGS=1 / --watchdogs): any XLA compile
     # after the first step is a recompile storm in the making — recorded
@@ -191,14 +218,7 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
             # resumes — including start_step 0, where a previous run that
             # died before its first checkpoint left records a fresh run in
             # the same directory must not append after
-            lines = [ln for ln in metrics_path.read_text().splitlines()
-                     if ln.strip()]
-
-            def _keep(ln: str) -> bool:
-                try:
-                    rec = json.loads(ln)
-                except json.JSONDecodeError:
-                    return False   # partial line from the crash mid-append
+            def _keep(rec: dict) -> bool:
                 if start_step == 0:
                     # fresh run in a reused dir: nothing from the dead run
                     # survives — step records, its manifest, its run_end
@@ -214,10 +234,9 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
                     return False   # unattributable event from the dead run
                 return rec.get("step", -1) < start_step
 
-            kept = [ln for ln in lines if _keep(ln)]
-            if len(kept) != len(lines):
-                metrics_path.write_text("".join(ln + "\n" for ln in kept))
-                log_fn(f"[train] metrics.jsonl: dropped {len(lines) - len(kept)} "
+            dropped = _rewrite_metrics_jsonl(metrics_path, _keep)
+            if dropped:
+                log_fn(f"[train] metrics.jsonl: dropped {dropped} "
                        f"record(s) from steps >= {start_step} (replayed)")
         # provenance: every session stamps its manifest (git sha, jax
         # versions, device kind, config hash) before the first step record —
@@ -230,119 +249,272 @@ def train(config: RAFTConfig, tconfig: TrainConfig, batch_iter: Iterable,
             f.write(json.dumps({"event": "manifest", **manifest},
                                default=str) + "\n")
 
-    rng = jax.random.PRNGKey(tconfig.seed + 1)
-    t0 = time.time()
-    seen = 0
-    nonfinite_streak = 0   # consecutive *logged* steps with non-finite loss
-    for batch_np in batch_iter:
-        step = int(state.step)
-        if step >= tconfig.num_steps:
-            break
-        trace_window.on_step(step)
-        rng, sub = jax.random.split(rng)
-        if multihost:
-            # each process feeds its local slice; the arrays are global,
-            # sharded over 'data' across every host's devices (rng/state are
-            # replicated, so the update is identical everywhere)
-            batch = Batch(*(mh_assemble(x) for x in tuple(batch_np)))
-            sub = mh_assemble(sub, jax.sharding.PartitionSpec())
-        else:
-            batch = Batch(*jax.tree.map(jnp.asarray, tuple(batch_np)))
-        # host-side stage scope: an XLA compile fired from inside this call
-        # (the recompile watchdog's listener) is attributed to 'train/step'
-        with stage("train/step"):
-            state, metrics = step_fn(state, batch, sub)
-        seen += 1
-        m_steps.inc()
-        if recompile_watch is not None and seen == 1:
-            # the first step's compile is expected; everything after is not
-            recompile_watch.arm()
-        if step % tconfig.log_every == 0 or step + 1 >= tconfig.num_steps:
-            m = jax.device_get(metrics)
-            rate = seen / max(time.time() - t0, 1e-9)
-            m_rate.set(rate)
-            log_fn(f"[train] step {step}  loss {float(m['loss']):.4f}  "
-                   f"epe {float(m['epe']):.3f}  1px {float(m['1px']):.3f}  "
-                   f"gnorm {float(m['grad_norm']):.2f}  {rate:.2f} it/s")
-            if metrics_path and is_main:
-                rec = {"step": step, "it_per_s": round(rate, 4),
-                       "wall_s": round(time.time() - t0, 2)}
-                rec.update({k: float(v) for k, v in m.items()})
-                with open(metrics_path, "a") as f:
-                    f.write(json.dumps(rec) + "\n")
-            # failure detection: an isolated bad batch is contained by
-            # apply_if_finite (update dropped, params stay healthy) — only
-            # *persistent* non-finiteness means the run is actually diverged
-            # and should stop rather than burn the remaining budget
-            if not np.isfinite(float(m["loss"])):
-                nonfinite_streak += 1
-                m_nonfinite.inc()
-            else:
-                nonfinite_streak = 0
-            if tconfig.halt_on_nonfinite and nonfinite_streak >= 3:
-                trace_window.stop()
-                raise FloatingPointError(
-                    f"non-finite loss at {nonfinite_streak} consecutive "
-                    f"logged steps (last: step {step}); last good checkpoint "
-                    f"is in {ckpt_dir or '<none>'}")
-        if ckpt_dir and is_main and (step + 1) % tconfig.ckpt_every == 0:
-            if _save_if_finite(Path(ckpt_dir) / f"ckpt_{step + 1}.npz",
-                               state, log_fn):
-                m_ckpts.inc()
-                # retention prunes only AFTER the atomic save succeeded:
-                # a failed/skipped save never shrinks the good set
-                if tconfig.keep_checkpoints:
-                    prune_checkpoints(ckpt_dir, tconfig.keep_checkpoints,
-                                      log_fn=log_fn)
-
-    trace_window.stop()
+    # ---- resilience plumbing (training/resilience.py) -------------------
+    run_log = tlm_events.current()
+    guard = PreemptionGuard().install()
+    # divergence rollback: single-host only (a per-process rollback decision
+    # under multi-host would diverge the replicated state); the restore
+    # point is an in-memory host snapshot, promoted by the writer whenever
+    # a checkpoint passes its finite check
+    last_good = LastGood()
+    # halt_on_nonfinite=False is the explicit "ride through non-finite
+    # steps" opt-out; the rollback ladder ends in an abort, so it must
+    # honor the same switch (apply_if_finite containment still applies)
+    sentinel_on = bool(ckpt_dir) and tconfig.max_rollbacks > 0 \
+        and tconfig.halt_on_nonfinite and not multihost
+    if sentinel_on:
+        last_good.update(start_step, jax.device_get(state))
+    writer = None
     if ckpt_dir and is_main:
-        if _save_if_finite(Path(ckpt_dir) / f"ckpt_{int(state.step)}.npz",
-                           state, log_fn, final=True):
-            m_ckpts.inc()
-            if tconfig.keep_checkpoints:
-                prune_checkpoints(ckpt_dir, tconfig.keep_checkpoints,
-                                  log_fn=log_fn)
-    if recompile_watch is not None:
-        recompile_watch.remove()
-        if recompile_watch.recompiles:
-            log_fn(f"[train] watchdog: {recompile_watch.recompiles} "
-                   f"recompile(s) after the first step — see run log")
-    if metrics_path and is_main:
+        writer = CheckpointWriter(
+            log_fn=log_fn, sync=not tconfig.async_checkpointing,
+            keep=tconfig.keep_checkpoints, faults=faults,
+            metrics={"saved": m_ckpts, "write_seconds": m_ckpt_write,
+                     "queue_depth": m_ckpt_queue},
+            run_log=run_log,
+            on_good=last_good.update if sentinel_on else None)
+
+    def _restore_from(host_state):
+        # single-host by construction: sentinel_on excludes multihost (a
+        # per-process rollback decision would diverge replicated state)
+        return jax.tree.map(jnp.asarray, host_state)
+
+    def _drop_metrics_from(from_step: int) -> None:
+        # in-session rollback purge: records at/past the restore point are
+        # about to be re-logged by the replayed steps — without this the
+        # stream would carry duplicate/conflicting step records (events,
+        # incl. this session's manifest, stay)
+        if not (metrics_path and is_main and metrics_path.exists()):
+            return
+        _rewrite_metrics_jsonl(
+            metrics_path,
+            lambda rec: "event" in rec or rec.get("step", -1) < from_step)
+
+    def _write_run_end(final_step: int) -> None:
         # end-of-session registry snapshot: the record `tlm summary` reports
         # and `tlm compare` diffs between two runs.  The input pipeline
         # (PrefetchLoader, MPSampleLoader) counts on the process-default
         # registry — merge its raft_data_* families in so wait-time /
-        # starvation shows up next to the training throughput.
+        # starvation / respawns show up next to the training throughput.
+        if not (metrics_path and is_main):
+            return
         from ..telemetry import default_registry
         data_metrics = {k: v for k, v in default_registry().snapshot().items()
                         if k.startswith("raft_data_")}
         with open(metrics_path, "a") as f:
             f.write(json.dumps({"event": "run_end",
-                                "final_step": int(state.step),
+                                "final_step": final_step,
                                 "metrics": {**registry.snapshot(),
                                             **data_metrics}},
                                default=str) + "\n")
+
+    def _preempt_exit():
+        # SIGTERM/SIGINT landed: the in-flight step has finished — drain an
+        # emergency checkpoint through the writer, stamp the run-log event,
+        # close the metrics stream, and exit with the distinct code
+        estep = int(state.step)
+        ckpt_path = None
+        if writer is not None:
+            p = Path(ckpt_dir) / f"ckpt_{estep}.npz"
+            # preemption on a checkpoint-boundary step: the periodic submit
+            # already enqueued this exact snapshot — a second D2H copy +
+            # serialize+fsync would burn the kill grace window for nothing
+            if writer.last_submitted != p:
+                writer.submit(p, jax.device_get(state), estep, final=True)
+            writer.close()
+            ckpt_path = p if writer.last_path == p else None
+        trace_window.stop()
+        if run_log is not None:
+            run_log.event("preempted", step=estep, signum=guard.signum,
+                          ckpt=str(ckpt_path) if ckpt_path else None)
+        log_fn(f"[train] preempted at step {estep} (signal {guard.signum}); "
+               f"emergency checkpoint: "
+               f"{ckpt_path or 'NOT written (non-finite state or no ckpt dir)'}")
+        _write_run_end(estep)
+        raise TrainingPreempted(estep, guard.signum, ckpt_path)
+
+    try:
+        rng = jax.random.PRNGKey(tconfig.seed + 1)
+        t0 = time.time()
+        seen = 0
+        nonfinite_streak = 0   # consecutive *logged* steps with non-finite loss
+        consec_rollbacks = 0
+        total_rollbacks = 0
+        pending_check = None   # (step, device metrics) — lag-1 sentinel window
+        for batch_np in batch_iter:
+            step = int(state.step)
+            if step >= tconfig.num_steps:
+                break
+            if guard.requested:
+                _preempt_exit()
+            trace_window.on_step(step)
+            if faults is not None:
+                batch_np = faults.corrupt_batch(tuple(batch_np))
+                faults.maybe_preempt(step)
+            rng, sub = jax.random.split(rng)
+            if multihost:
+                # each process feeds its local slice; the arrays are global,
+                # sharded over 'data' across every host's devices (rng/state are
+                # replicated, so the update is identical everywhere)
+                batch = Batch(*(mh_assemble(x) for x in tuple(batch_np)))
+                sub = mh_assemble(sub, jax.sharding.PartitionSpec())
+            else:
+                batch = Batch(*jax.tree.map(jnp.asarray, tuple(batch_np)))
+            # host-side stage scope: an XLA compile fired from inside this call
+            # (the recompile watchdog's listener) is attributed to 'train/step'
+            with stage("train/step"):
+                state, metrics = step_fn(state, batch, sub)
+            seen += 1
+            m_steps.inc()
+            if recompile_watch is not None and seen == 1:
+                # the first step's compile is expected; everything after is not
+                recompile_watch.arm()
+            # non-finite sentinel, lag-1: the PREVIOUS step's metrics are
+            # materialized by now (its compute overlapped this step's dispatch),
+            # so the per-step check costs a tiny host readback, not a pipeline
+            # bubble.  On a hit, both the poisoned step and the in-flight one
+            # are discarded by restoring the last good snapshot.
+            if sentinel_on and pending_check is not None:
+                pstep, pmetrics = pending_check
+                pm = jax.device_get(pmetrics)
+                if not (np.isfinite(float(pm["loss"]))
+                        and np.isfinite(float(pm["grad_norm"]))):
+                    m_nonfinite.inc()
+                    consec_rollbacks += 1
+                    if writer is not None:
+                        # the restore point is promoted on the writer thread
+                        # (after its finite check); drain so a checkpoint
+                        # submitted just before this step can't lose the race
+                        # and roll us back further than necessary
+                        writer.drain()
+                    gstep, ghost = last_good.get()
+                    if consec_rollbacks > tconfig.max_rollbacks:
+                        trace_window.stop()
+                        raise FloatingPointError(
+                            f"non-finite loss/grad at step {pstep} persisted "
+                            f"through {tconfig.max_rollbacks} consecutive "
+                            f"rollback(s); giving up — last good checkpoint is "
+                            f"step {gstep} in {ckpt_dir}")
+                    m_rollbacks.inc()
+                    total_rollbacks += 1
+                    state = _restore_from(ghost)
+                    # the data stream never rewinds, so continuing SKIPS the
+                    # offending window; folding the retry count into the key
+                    # re-randomizes everything keyed off the step rng
+                    rng = jax.random.fold_in(rng, 104_729 + total_rollbacks)
+                    _drop_metrics_from(gstep)
+                    if run_log is not None:
+                        run_log.event("rollback", from_step=pstep, to_step=gstep,
+                                      consecutive=consec_rollbacks)
+                    log_fn(f"[train] non-finite loss/grad at step {pstep}: "
+                           f"rolled back to step {gstep} "
+                           f"({consec_rollbacks}/{tconfig.max_rollbacks} "
+                           f"consecutive); continuing past the offending data "
+                           f"window")
+                    pending_check = None
+                    continue
+                consec_rollbacks = 0
+            if sentinel_on:
+                pending_check = (step, metrics)
+            if step % tconfig.log_every == 0 or step + 1 >= tconfig.num_steps:
+                m = jax.device_get(metrics)
+                rate = seen / max(time.time() - t0, 1e-9)
+                m_rate.set(rate)
+                log_fn(f"[train] step {step}  loss {float(m['loss']):.4f}  "
+                       f"epe {float(m['epe']):.3f}  1px {float(m['1px']):.3f}  "
+                       f"gnorm {float(m['grad_norm']):.2f}  {rate:.2f} it/s")
+                if metrics_path and is_main:
+                    rec = {"step": step, "it_per_s": round(rate, 4),
+                           "wall_s": round(time.time() - t0, 2)}
+                    rec.update({k: float(v) for k, v in m.items()})
+                    with open(metrics_path, "a") as f:
+                        f.write(json.dumps(rec) + "\n")
+                # failure detection: an isolated bad batch is contained by
+                # apply_if_finite (update dropped, params stay healthy) — only
+                # *persistent* non-finiteness means the run is actually diverged
+                # and should stop rather than burn the remaining budget
+                if not np.isfinite(float(m["loss"])):
+                    nonfinite_streak += 1
+                    if not sentinel_on:
+                        # the sentinel already counted this step's non-finite
+                        m_nonfinite.inc()
+                else:
+                    nonfinite_streak = 0
+                if (not sentinel_on and tconfig.halt_on_nonfinite
+                        and nonfinite_streak >= 3):
+                    # rollback disabled (no ckpt_dir / --max-rollbacks 0):
+                    # the historical halt-after-3-logged-steps applies
+                    trace_window.stop()
+                    raise FloatingPointError(
+                        f"non-finite loss at {nonfinite_streak} consecutive "
+                        f"logged steps (last: step {step}); last good checkpoint "
+                        f"is in {ckpt_dir or '<none>'}")
+            if writer is not None and (step + 1) % tconfig.ckpt_every == 0:
+                # snapshot at the step boundary (one D2H copy); serialization,
+                # fsync, verify and retention all happen on the writer thread —
+                # the step loop never blocks on disk (--sync-ckpt restores the
+                # historical inline save)
+                writer.submit(Path(ckpt_dir) / f"ckpt_{step + 1}.npz",
+                              jax.device_get(state), step + 1)
+            if guard.requested:
+                _preempt_exit()
+
+        trace_window.stop()
+        if writer is not None:
+            fp = Path(ckpt_dir) / f"ckpt_{int(state.step)}.npz"
+            # skip the final submit when num_steps lands on a checkpoint
+            # boundary — the periodic submit already carried this snapshot
+            if writer.last_submitted != fp:
+                writer.submit(fp, jax.device_get(state), int(state.step),
+                              final=True)
+            writer.close()
+        if recompile_watch is not None:
+            recompile_watch.remove()
+            if recompile_watch.recompiles:
+                log_fn(f"[train] watchdog: {recompile_watch.recompiles} "
+                       f"recompile(s) after the first step — see run log")
+        _write_run_end(int(state.step))
+    finally:
+        # symmetric teardown on EVERY exit (normal, halt,
+        # preempted, a raising step): restore the process's
+        # signal handlers and stop the writer thread.  On the
+        # happy path the explicit close above already drained
+        # and surfaced writer failures; here the primary
+        # exception (if any) must win.
+        guard.remove()
+        if writer is not None:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
     return state
+
+
+def _rewrite_metrics_jsonl(path: Path, keep) -> int:
+    """Filter a metrics.jsonl in place: keep records for which ``keep(rec)``
+    is true, always drop undecodable (partial) lines from a crash
+    mid-append.  Returns the number of lines removed.  Shared by the resume
+    replay filter and the in-session rollback purge so both purge paths
+    track the record schema together."""
+    lines = [ln for ln in path.read_text().splitlines() if ln.strip()]
+    kept = []
+    for ln in lines:
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if keep(rec):
+            kept.append(ln)
+    if len(kept) != len(lines):
+        path.write_text("".join(ln + "\n" for ln in kept))
+    return len(lines) - len(kept)
 
 
 def _save_if_finite(path: Path, state: TrainState, log_fn,
                     final: bool = False) -> bool:
-    """Never persist poisoned params: a checkpoint written after NaN updates
-    slipped through (apply_if_finite passes through after its error budget)
-    would later be resumed as the 'last good' state.  Returns True when a
-    checkpoint was actually written."""
-    host_state = jax.device_get(state)
-    bad = [() for x in (jax.tree.leaves(host_state.params)
-                        + jax.tree.leaves(host_state.bn_state))
-           if not np.isfinite(np.asarray(x)).all()]
-    if bad:
-        log_fn(f"[train] NOT saving {path}: {len(bad)} param tensor(s) "
-               f"non-finite (diverged); last good checkpoint is unchanged")
-        return False
-    save_checkpoint(path, host_state)
-    log_fn(f"[train] saved {'final ' if final else ''}{path}")
-    return True
+    """Historical inline entry (tests use it directly): device_get + the
+    shared ``resilience.save_if_finite`` finite-check-then-save."""
+    return save_if_finite(path, jax.device_get(state), log_fn, final=final)
 
 
 def _dp_sharding(pcount: int, tconfig: TrainConfig):
@@ -361,8 +533,20 @@ def _dp_sharding(pcount: int, tconfig: TrainConfig):
 
 
 def train_cli(args, config: RAFTConfig) -> int:
+    import os
+
     from ..data.pipeline import (BatchBuffers, PrefetchLoader, batched,
                                  synthetic_batches)
+    from .faults import make_train_injector
+
+    # training-plane chaos harness (--chaos-train / RAFT_TPU_CHAOS_TRAIN):
+    # one injector shared by the loop (nan_loss/torn_ckpt/preempt arms) and
+    # the data loader (worker_kill/worker_stall); None = zero overhead
+    chaos_spec = (getattr(args, "chaos_train", None)
+                  or os.environ.get("RAFT_TPU_CHAOS_TRAIN"))
+    faults = make_train_injector(chaos_spec, run_log=tlm_events.current())
+    if faults is not None:
+        print(f"[train] CHAOS ARMED: {chaos_spec}")
 
     # stage presets carry the official curriculum hyperparameters (steps,
     # lr, batch, crop, decay — TrainConfig.for_stage); explicit flags win
@@ -390,6 +574,19 @@ def train_cli(args, config: RAFTConfig) -> int:
                       f"got {val}")
                 return 2
             overrides[flag] = val
+    if getattr(args, "async_ckpt", None) is not None:
+        overrides["async_checkpointing"] = args.async_ckpt
+    if getattr(args, "max_rollbacks", None) is not None:
+        if args.max_rollbacks < 0:
+            print(f"ERROR: --max-rollbacks must be >= 0 (0 disables), "
+                  f"got {args.max_rollbacks}")
+            return 2
+        overrides["max_rollbacks"] = args.max_rollbacks
+    if getattr(args, "worker_respawns", None) is not None \
+            and args.worker_respawns < 0:
+        print(f"ERROR: --worker-respawns must be >= 0 (0 = fail fast), "
+              f"got {args.worker_respawns}")
+        return 2
     tconfig = TrainConfig.for_stage(args.dataset, **overrides)
 
     # stage warm start (official curriculum: each stage --load's the previous
@@ -477,12 +674,15 @@ def train_cli(args, config: RAFTConfig) -> int:
             stall = getattr(args, "stall_timeout", 300.0)
             shm_slots = getattr(args, "shm_slots", None)
             transport = "pickle" if shm_slots == 0 else "shm"
+            respawns = getattr(args, "worker_respawns", None)
             mp_loader = MPSampleLoader(
                 ds, num_workers=workers, seed=seed,
                 start_method=getattr(args, "mp_start", "forkserver"),
                 stall_timeout=None if not stall else stall,
                 transport=transport,
-                shm_slots=shm_slots if shm_slots else None)
+                shm_slots=shm_slots if shm_slots else None,
+                faults=faults,
+                max_respawns=respawns if respawns is not None else 3)
             sample_iter = iter(mp_loader)
             print(f"[train] {workers} decode{'' if device_aug else '/augment'}"
                   f" worker processes ({transport} transport)")
@@ -518,7 +718,15 @@ def train_cli(args, config: RAFTConfig) -> int:
         train(config, tconfig, batch_iter, ckpt_dir=ckpt_dir,
               trace_dir=getattr(args, "trace", None),
               trace_steps=getattr(args, "trace_steps", None) or 4,
-              init_params=init_params)
+              init_params=init_params, faults=faults)
+    except TrainingPreempted as e:
+        # distinct exit code: "requeue me and rerun the same command", not
+        # "debug a crash" — resume goes through restore_latest_with_fallback
+        print(f"[train] PREEMPTED at step {e.step}: exit "
+              f"{PREEMPT_EXIT_CODE}; rerun the same command to resume"
+              + (f" from {e.ckpt_path}" if e.ckpt_path else
+                 " from the last periodic checkpoint"))
+        return PREEMPT_EXIT_CODE
     finally:
         # drain order matters: stop the prefetch pump first (it would keep
         # decoding and device_put-ing after a max_steps break, pinning

@@ -130,7 +130,10 @@ def test_mp_loader_detects_silent_worker_death():
     import time
 
     ds = SyntheticFlowDataset(size=(32, 48), length=100, seed=0)
-    loader = MPSampleLoader(ds, num_workers=2, seed=0, poll_timeout=0.5)
+    # max_respawns=0 pins the historical fail-fast escalation; the default
+    # heals by respawning (tests/test_train_chaos.py covers that path)
+    loader = MPSampleLoader(ds, num_workers=2, seed=0, poll_timeout=0.5,
+                            max_respawns=0)
     try:
         it = iter(loader)
         next(it)
@@ -162,7 +165,8 @@ def test_mp_loader_detects_alive_but_stalled_workers():
     """A deadlocked worker is ALIVE, so death detection never fires; the
     stall detector must raise instead of polling forever."""
     loader = MPSampleLoader(_Hanging(), num_workers=2, seed=0, shuffle=False,
-                            epochs=1, poll_timeout=0.2, stall_timeout=1.5)
+                            epochs=1, poll_timeout=0.2, stall_timeout=1.5,
+                            max_respawns=0)
     with pytest.raises(RuntimeError, match="produced nothing"):
         for _ in loader:
             pass

@@ -1050,3 +1050,143 @@ def test_keep_checkpoints_retention_in_training_loop(tmp_path):
     assert any("corrupt" in m for m in logs)
     assert any("resumed" in m and "ckpt_3" in m for m in logs)
     assert [s for s, _ in list_checkpoints(ckpt_dir)] == [5, 6]
+
+
+# ---------------------------------- resilience: rollback + preemption ----
+
+def _repeated_batch_stream(batch=2, size=(32, 48), seed=0):
+    """The SAME batch forever: a rollback's replayed steps re-apply
+    identical updates, so final params must match the clean run exactly
+    (dropout is 0 — the re-randomized PRNG stream is unused)."""
+    rng = np.random.RandomState(seed)
+    h, w = size
+    b = (rng.rand(batch, h, w, 3).astype(np.float32),
+         rng.rand(batch, h, w, 3).astype(np.float32),
+         (rng.rand(batch, h, w, 2).astype(np.float32) - .5) * 4,
+         np.ones((batch, h, w), np.float32))
+    while True:
+        yield b
+
+
+def _indexed_stream(batch=2, size=(32, 48), start=0, seed=0):
+    """Step-indexed deterministic batches: a resumed run passes ``start``
+    so the data/step pairing matches the uninterrupted baseline."""
+    i = start
+    while True:
+        rng = np.random.RandomState(seed * 7919 + i)
+        h, w = size
+        yield (rng.rand(batch, h, w, 3).astype(np.float32),
+               rng.rand(batch, h, w, 3).astype(np.float32),
+               (rng.rand(batch, h, w, 2).astype(np.float32) - .5) * 4,
+               np.ones((batch, h, w), np.float32))
+        i += 1
+
+
+def _resilience_tconfig(**over):
+    base = dict(num_steps=8, batch_size=2, lr=1e-4, schedule="constant",
+                ckpt_every=3, log_every=1, image_size=(32, 48))
+    return TrainConfig(**{**base, **over})
+
+
+@pytest.mark.slow
+def test_divergence_rollback_recovers_and_converges(tmp_path):
+    """One NaN-poisoned step (chaos arm nan_loss) must trigger EXACTLY one
+    rollback to the last good checkpoint snapshot, purge the replayed
+    metrics records, and end with params matching the clean run."""
+    import json
+
+    from raft_tpu.training.faults import (TrainFaultInjector,
+                                          parse_train_chaos_spec)
+    from raft_tpu.training.loop import train
+
+    config = RAFTConfig.small_model(iters=2)
+    clean = train(config, _resilience_tconfig(), _repeated_batch_stream(),
+                  ckpt_dir=str(tmp_path / "clean"), data_parallel=False,
+                  log_fn=lambda m: None)
+
+    inj = TrainFaultInjector(parse_train_chaos_spec("seed=1"))
+    inj.force("nan_loss", [0, 0, 0, 0, 1])        # poison step 4 only
+    logs = []
+    ckpt = tmp_path / "nan"
+    chaos = train(config, _resilience_tconfig(), _repeated_batch_stream(),
+                  ckpt_dir=str(ckpt), data_parallel=False,
+                  log_fn=logs.append, faults=inj)
+    assert any("rolled back to step 3" in m for m in logs), logs
+    recs = [json.loads(l) for l in
+            (ckpt / "metrics.jsonl").read_text().splitlines()]
+    end = [r for r in recs if r.get("event") == "run_end"][-1]["metrics"]
+    assert end["raft_train_rollbacks_total"] == 1
+    assert end["raft_fault_injected_total"] == {"nan_loss": 1.0}
+    steps = [r["step"] for r in recs if "step" in r and "event" not in r]
+    assert steps == sorted(set(steps)), steps     # no duplicate records
+    for a, b in zip(jax.tree.leaves(clean.params),
+                    jax.tree.leaves(chaos.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+@pytest.mark.slow
+def test_rollback_budget_aborts_with_diagnosis(tmp_path):
+    """Persistently non-finite steps must stop the run after max_rollbacks
+    CONSECUTIVE rollbacks, not loop forever (and the counter must show the
+    budget was actually spent)."""
+    import json
+
+    from raft_tpu.training.loop import train
+
+    def poisoned():
+        while True:
+            im = np.full((2, 32, 48, 3), np.nan, np.float32)
+            yield (im, im, np.zeros((2, 32, 48, 2), np.float32),
+                   np.ones((2, 32, 48), np.float32))
+
+    config = RAFTConfig.small_model(iters=2)
+    tconfig = _resilience_tconfig(max_rollbacks=2)
+    with pytest.raises(FloatingPointError, match="non-finite loss"):
+        train(config, tconfig, poisoned(), ckpt_dir=str(tmp_path),
+              data_parallel=False, log_fn=lambda m: None)
+
+
+@pytest.mark.slow
+def test_preempt_resume_equivalence(tmp_path):
+    """ISSUE 14 satellite: kill a run at step k via the preempt arm, resume,
+    and assert final params match the uninterrupted run and metrics.jsonl
+    carries no duplicate or orphaned step records."""
+    import json
+
+    from raft_tpu.training.checkpoint import checkpoint_readable
+    from raft_tpu.training.faults import (TrainFaultInjector,
+                                          parse_train_chaos_spec)
+    from raft_tpu.training.loop import train
+    from raft_tpu.training.resilience import TrainingPreempted
+
+    config = RAFTConfig.small_model(iters=2)
+    clean = train(config, _resilience_tconfig(), _indexed_stream(),
+                  ckpt_dir=str(tmp_path / "clean"), data_parallel=False,
+                  log_fn=lambda m: None)
+
+    ckpt = tmp_path / "pre"
+    inj = TrainFaultInjector(parse_train_chaos_spec("seed=1,preempt=5"))
+    with pytest.raises(TrainingPreempted) as e:
+        train(config, _resilience_tconfig(), _indexed_stream(),
+              ckpt_dir=str(ckpt), data_parallel=False,
+              log_fn=lambda m: None, faults=inj)
+    # the in-flight step finished: preempted AT step 5 -> state at step 6
+    assert e.value.step == 6 and e.value.signum is not None
+    assert e.value.ckpt_path is not None
+    assert checkpoint_readable(e.value.ckpt_path)
+
+    resumed = train(config, _resilience_tconfig(),
+                    _indexed_stream(start=e.value.step),
+                    ckpt_dir=str(ckpt), data_parallel=False,
+                    log_fn=lambda m: None)
+    for a, b in zip(jax.tree.leaves(clean.params),
+                    jax.tree.leaves(resumed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    recs = [json.loads(l) for l in
+            (ckpt / "metrics.jsonl").read_text().splitlines()]
+    steps = [r["step"] for r in recs if "step" in r and "event" not in r]
+    assert steps == sorted(set(steps)) and steps[-1] == 7, steps
+    # one manifest per session, and the preempted session's run_end stayed
+    assert sum(r.get("event") == "manifest" for r in recs) == 2
+    ends = [r for r in recs if r.get("event") == "run_end"]
+    assert [r["final_step"] for r in ends] == [6, 8]

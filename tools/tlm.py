@@ -136,6 +136,21 @@ def summary_lines(path) -> List[str]:
         kinds[kind] = kinds.get(kind, 0) + 1
     out.append("  events: " + ", ".join(f"{k}={n}"
                                         for k, n in sorted(kinds.items())))
+    # training-resilience events (OBSERVABILITY.md "Training resilience"):
+    # surfaced the same way data starvation is, so a preempted or
+    # rolled-back run is obvious from one `tlm summary`
+    if kinds.get("preempted"):
+        out.append("  PREEMPTED: run stopped on SIGTERM/SIGINT after an "
+                   "emergency checkpoint (exit code 17) — rerun the same "
+                   "command to resume")
+    if kinds.get("ckpt_queue_saturated"):
+        out.append(f"  ASYNC-CKPT QUEUE SATURATED "
+                   f"{kinds['ckpt_queue_saturated']}x: the step loop "
+                   f"blocked on the checkpoint writer — the disk is slower "
+                   f"than --ckpt-every")
+    if kinds.get("fault_injected"):
+        out.append(f"  chaos: {kinds['fault_injected']} fault(s) injected "
+                   f"(--chaos / --chaos-train drill)")
     steps = _step_records(records)
     if steps:
         first, last = steps[0], steps[-1]
@@ -162,6 +177,26 @@ def summary_lines(path) -> List[str]:
                     f"iteration(s) over {iu['count']} sample(s) — the "
                     f"converge early-exit saving vs the declared max "
                     f"(--iters-policy, OBSERVABILITY.md)")
+            rb = rec["metrics"].get("raft_train_rollbacks_total")
+            if rb:
+                out.append(
+                    f"  DIVERGENCE ROLLBACKS: {int(rb)} — non-finite "
+                    f"steps restored from the last good checkpoint "
+                    f"snapshot (aborts after --max-rollbacks consecutive; "
+                    f"see `rollback` events for the step windows)")
+            rsp = rec["metrics"].get("raft_data_worker_respawns_total")
+            if rsp:
+                out.append(
+                    f"  data-worker respawns: {int(rsp)} — dead/stalled "
+                    f"worker pools healed in place (`worker_respawn` "
+                    f"events carry per-worker exitcodes + shm free-list "
+                    f"depth)")
+            cw = rec["metrics"].get("raft_ckpt_write_seconds")
+            if isinstance(cw, dict) and cw.get("count"):
+                out.append(
+                    f"  checkpoint writer: {cw['count']} write(s), mean "
+                    f"{cw['mean'] * 1000:.0f} ms each kept off the step "
+                    f"path (async; --sync-ckpt restores inline saves)")
         if rec.get("event") == "nonfinite":
             out.append(f"  NONFINITE at stage {rec.get('stage')!r} "
                        f"({rec.get('bad_values')} value(s))")

@@ -1,0 +1,368 @@
+#!/usr/bin/env python
+"""Training-plane chaos drill: arm every fault arm on short synthetic runs
+and assert the resilience layer recovers (ISSUE 14; the training twin of
+``serve_bench --chaos``).
+
+Phases (each a fresh in-process ``train()`` on a deterministic stream):
+
+1. **clean** — the uninterrupted baseline: final params + per-step wall
+   times (checkpoint steps vs plain steps), async writer on.
+2. **sync control** — same run with ``--sync-ckpt``: proves async vs sync
+   train the SAME model bit-for-bit, and reports how much checkpoint I/O
+   the async writer removed from the step path (ckpt-step p95 vs plain).
+3. **nan_loss** — one step's batch NaN-poisoned: exactly one rollback,
+   the run completes, final params match the clean run (the stream
+   repeats one batch, so replayed updates are identical).
+4. **preempt** — SIGTERM at a chosen step: the run exits through
+   ``TrainingPreempted`` with a READABLE emergency checkpoint; a resumed
+   run finishes and matches the uninterrupted baseline (step-indexed
+   stream, so the data/step pairing survives the restart).
+5. **torn_ckpt** — the first write is truncated post-rename: the async
+   writer's verify pass removes it, ``latest_checkpoint`` never points at
+   an unreadable file, later checkpoints land clean.
+6. **worker_kill / worker_stall** — a data worker is SIGKILLed / the pool
+   stalls: the loader respawns (shm slots reclaimed), the stream keeps
+   flowing, zero aborts.
+
+Writes a verdict JSON (default ``<out>/TRAIN_CHAOS.json``) and exits
+non-zero on any failed assertion — the CI training-chaos smoke gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _fixed_batch(batch, size, seed=0):
+    rng = np.random.RandomState(seed)
+    h, w = size
+    return (rng.rand(batch, h, w, 3).astype(np.float32),
+            rng.rand(batch, h, w, 3).astype(np.float32),
+            (rng.rand(batch, h, w, 2).astype(np.float32) - 0.5) * 4.0,
+            np.ones((batch, h, w), np.float32))
+
+
+def repeated_stream(batch, size, seed=0):
+    """The SAME batch forever: rollback replays become exact re-updates, so
+    final params must match the clean run to float tolerance."""
+    b = _fixed_batch(batch, size, seed)
+    while True:
+        yield b
+
+
+def indexed_stream(batch, size, start=0, seed=0):
+    """Step-indexed deterministic batches: a resumed run passes ``start``
+    so the data/step pairing matches the uninterrupted baseline exactly."""
+    i = start
+    while True:
+        yield _fixed_batch(batch, size, seed * 7919 + i)
+        i += 1
+
+
+class TimedIter:
+    """Wraps a batch stream; pull-to-pull deltas approximate per-step wall
+    time (pull N+1 happens after step N's host-side work incl. any
+    checkpoint submission)."""
+
+    def __init__(self, it):
+        self.it = it
+        self.t = []
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self.t.append(time.monotonic())
+        return next(self.it)
+
+    def deltas(self):
+        return [b - a for a, b in zip(self.t, self.t[1:])]
+
+
+def _pctl(vals, q):
+    vals = sorted(vals)
+    return vals[min(len(vals) - 1, int(q * len(vals)))] if vals else 0.0
+
+
+def _run_end(ckpt_dir: Path) -> dict:
+    recs = [json.loads(ln) for ln in
+            (ckpt_dir / "metrics.jsonl").read_text().splitlines()
+            if ln.strip()]
+    ends = [r for r in recs if r.get("event") == "run_end"]
+    return ends[-1]["metrics"] if ends else {}
+
+
+def _metric_steps(ckpt_dir: Path):
+    recs = []
+    for ln in (ckpt_dir / "metrics.jsonl").read_text().splitlines():
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if "step" in rec and "event" not in rec:
+            recs.append(rec["step"])
+    return recs
+
+
+def _params_close(a, b, atol, label, problems):
+    import jax
+    worst = 0.0
+    for x, y in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
+        worst = max(worst, float(np.max(np.abs(np.asarray(x)
+                                               - np.asarray(y)))))
+    if worst > atol:
+        problems.append(f"{label}: params diverge (max |diff| {worst:.3g} "
+                        f"> {atol:g})")
+    return worst
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description="training-plane chaos drill")
+    p.add_argument("--out", default="run_train_chaos",
+                   help="output root (per-phase ckpt dirs + verdict JSON)")
+    p.add_argument("--seed", type=int, default=5,
+                   help="chaos + data seed (fires replay deterministically)")
+    p.add_argument("--steps", type=int, default=None,
+                   help="steps per phase run (default 9, or 7 with --smoke)")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI fast path: fewer steps, same assertions")
+    args = p.parse_args()
+
+    import jax  # noqa: E402  (after argparse: --help must not init a backend)
+
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.training.checkpoint import (checkpoint_readable,
+                                              latest_checkpoint,
+                                              list_checkpoints)
+    from raft_tpu.training.faults import (TrainFaultInjector,
+                                          parse_train_chaos_spec)
+    from raft_tpu.training.loop import train
+    from raft_tpu.training.resilience import TrainingPreempted
+    from raft_tpu.telemetry import run_manifest
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    steps = args.steps or (7 if args.smoke else 9)
+    batch, size = 2, (32, 48)
+    config = RAFTConfig.small_model(iters=2)
+
+    def tconf(**over):
+        base = dict(num_steps=steps, batch_size=batch, lr=1e-4,
+                    schedule="constant", ckpt_every=3, log_every=1,
+                    image_size=size, seed=args.seed)
+        return TrainConfig(**{**base, **over})
+
+    problems = []
+    report = {"manifest": run_manifest(config=config, mode="train_chaos"),
+              "seed": args.seed, "steps": steps, "phases": {}}
+    quiet = lambda m: None  # noqa: E731
+
+    # ---- 1. clean baseline (async ckpt, default) ------------------------
+    d_clean = out / "clean"
+    it = TimedIter(indexed_stream(batch, size, seed=args.seed))
+    t0 = time.time()
+    clean = train(config, tconf(), it, ckpt_dir=str(d_clean),
+                  data_parallel=False, log_fn=quiet)
+    deltas = it.deltas()[1:]          # drop the compile step
+    ck = [d for i, d in enumerate(deltas, start=1)
+          if (i + 1) % 3 == 0]        # pull after a checkpoint-submitting step
+    plain = [d for i, d in enumerate(deltas, start=1) if (i + 1) % 3 != 0]
+    report["phases"]["clean"] = {
+        "wall_s": round(time.time() - t0, 2),
+        "ckpt_step_p95_ms": round(_pctl(ck, 0.95) * 1e3, 2),
+        "plain_step_p95_ms": round(_pctl(plain, 0.95) * 1e3, 2)}
+    print(f"[chaos] clean: ckpt-step p95 "
+          f"{report['phases']['clean']['ckpt_step_p95_ms']}ms vs plain "
+          f"{report['phases']['clean']['plain_step_p95_ms']}ms (async)")
+
+    # ---- 2. sync control: bit-for-bit equality + step-path cost ---------
+    d_sync = out / "sync"
+    it = TimedIter(indexed_stream(batch, size, seed=args.seed))
+    sync = train(config, tconf(async_checkpointing=False), it,
+                 ckpt_dir=str(d_sync), data_parallel=False, log_fn=quiet)
+    for a, b in zip(jax.tree.leaves(clean.params), jax.tree.leaves(sync.params)):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            problems.append("sync-ckpt run is not bit-identical to async")
+            break
+    deltas = it.deltas()[1:]
+    ck_s = [d for i, d in enumerate(deltas, start=1) if (i + 1) % 3 == 0]
+    report["phases"]["sync"] = {
+        "ckpt_step_p95_ms": round(_pctl(ck_s, 0.95) * 1e3, 2)}
+    # acceptance: async removes checkpoint I/O from the step path — a
+    # checkpoint step must look like a plain step (generous bound: CPU CI
+    # machines jitter; the sync number is reported alongside for scale)
+    cl = report["phases"]["clean"]
+    if ck and plain and cl["ckpt_step_p95_ms"] > \
+            max(2.5 * cl["plain_step_p95_ms"], cl["plain_step_p95_ms"] + 50):
+        problems.append(
+            f"async ckpt-step p95 {cl['ckpt_step_p95_ms']}ms is an outlier "
+            f"vs plain {cl['plain_step_p95_ms']}ms — checkpoint I/O leaked "
+            f"back into the step path")
+    print(f"[chaos] sync control: ckpt-step p95 "
+          f"{report['phases']['sync']['ckpt_step_p95_ms']}ms (blocking); "
+          f"async == sync params: bitwise")
+
+    # ---- 3. nan_loss -> exactly one rollback, converges -----------------
+    d_nan = out / "nan"
+    nan_at = steps - 3                # after the first checkpoint exists
+    inj = TrainFaultInjector(parse_train_chaos_spec(f"seed={args.seed}"))
+    inj.force("nan_loss", [0] * nan_at + [1])
+    clean_rep = train(config, tconf(), repeated_stream(batch, size,
+                                                       seed=args.seed),
+                      ckpt_dir=str(out / "clean_rep"), data_parallel=False,
+                      log_fn=quiet)
+    nan_state = train(config, tconf(), repeated_stream(batch, size,
+                                                       seed=args.seed),
+                      ckpt_dir=str(d_nan), data_parallel=False,
+                      log_fn=quiet, faults=inj)
+    m = _run_end(d_nan)
+    rollbacks = m.get("raft_train_rollbacks_total", 0)
+    if rollbacks != 1:
+        problems.append(f"nan_loss: expected exactly 1 rollback, "
+                        f"got {rollbacks}")
+    worst = _params_close(clean_rep, nan_state, 1e-4, "nan_loss rollback",
+                          problems)
+    steps_logged = _metric_steps(d_nan)
+    if steps_logged != sorted(set(steps_logged)):
+        problems.append(f"nan_loss: duplicate step records after rollback: "
+                        f"{steps_logged}")
+    report["phases"]["nan_loss"] = {"rollbacks": rollbacks,
+                                    "max_param_diff": worst}
+    print(f"[chaos] nan_loss: {int(rollbacks)} rollback, max |param diff| "
+          f"vs clean {worst:.2e}")
+
+    # ---- 4. preempt -> emergency ckpt + equivalent resume ---------------
+    d_pre = out / "preempt"
+    pre_at = steps - 3
+    inj = TrainFaultInjector(
+        parse_train_chaos_spec(f"seed={args.seed},preempt={pre_at}"))
+    preempted_ok = False
+    try:
+        train(config, tconf(), indexed_stream(batch, size, seed=args.seed),
+              ckpt_dir=str(d_pre), data_parallel=False, log_fn=quiet,
+              faults=inj)
+    except TrainingPreempted as e:
+        preempted_ok = True
+        if e.ckpt_path is None or not checkpoint_readable(e.ckpt_path):
+            problems.append(f"preempt: emergency checkpoint missing or "
+                            f"unreadable ({e.ckpt_path})")
+        resume_from = e.step
+    if not preempted_ok:
+        problems.append("preempt: SIGTERM did not surface as "
+                        "TrainingPreempted")
+        resume_from = 0
+    resumed = train(config, tconf(),
+                    indexed_stream(batch, size, start=resume_from,
+                                   seed=args.seed),
+                    ckpt_dir=str(d_pre), data_parallel=False, log_fn=quiet)
+    worst = _params_close(clean, resumed, 1e-4, "preempt resume", problems)
+    steps_logged = _metric_steps(d_pre)
+    if steps_logged != sorted(set(steps_logged)) \
+            or (steps_logged and steps_logged[-1] != steps - 1):
+        problems.append(f"preempt: metrics stream has duplicate or orphaned "
+                        f"step records after resume: {steps_logged}")
+    report["phases"]["preempt"] = {"preempt_step": pre_at,
+                                   "resumed_from": resume_from,
+                                   "max_param_diff": worst}
+    print(f"[chaos] preempt@{pre_at}: emergency ckpt readable, resumed from "
+          f"{resume_from}, max |param diff| vs uninterrupted {worst:.2e}")
+
+    # ---- 5. torn_ckpt -> verify pass removes it, latest stays readable --
+    d_torn = out / "torn"
+    inj = TrainFaultInjector(parse_train_chaos_spec(f"seed={args.seed}"))
+    inj.force("torn_ckpt", [1])       # tear the FIRST write only
+    train(config, tconf(), indexed_stream(batch, size, seed=args.seed),
+          ckpt_dir=str(d_torn), data_parallel=False, log_fn=quiet,
+          faults=inj)
+    torn_fired = inj.injected["torn_ckpt"]
+    unreadable = [str(p) for _, p in list_checkpoints(d_torn)
+                  if not checkpoint_readable(p)]
+    latest = latest_checkpoint(d_torn)
+    if torn_fired != 1:
+        problems.append(f"torn_ckpt: expected 1 tear, got {torn_fired}")
+    if unreadable:
+        problems.append(f"torn_ckpt: unreadable checkpoint(s) left on disk: "
+                        f"{unreadable}")
+    if latest is None or not checkpoint_readable(latest):
+        problems.append(f"torn_ckpt: latest_checkpoint {latest} unreadable")
+    report["phases"]["torn_ckpt"] = {"tears": torn_fired,
+                                     "latest": str(latest)}
+    print(f"[chaos] torn_ckpt: {torn_fired} tear injected, latest "
+          f"{latest.name if latest else None} readable, no torn file left")
+
+    # ---- 6. worker kill + stall -> respawn heals, zero aborts -----------
+    from raft_tpu.data.mp_loader import MPSampleLoader
+    from raft_tpu.data.synthetic import SyntheticFlowDataset
+    from raft_tpu.telemetry.registry import default_registry
+
+    def respawns():
+        return default_registry().snapshot().get(
+            "raft_data_worker_respawns_total", 0)
+
+    ds = SyntheticFlowDataset(size=(24, 32), length=64, seed=args.seed)
+    before = respawns()
+    inj = TrainFaultInjector(parse_train_chaos_spec(f"seed={args.seed}"))
+    inj.force("worker_kill", [0] * 4 + [1])
+    loader = MPSampleLoader(ds, num_workers=2, seed=args.seed,
+                            transport="shm", shm_slots=4, poll_timeout=0.5,
+                            stall_timeout=8.0, faults=inj, max_respawns=3)
+    it = iter(loader)
+    try:
+        for _ in range(24):
+            next(it)
+    except RuntimeError as e:
+        problems.append(f"worker_kill: loader aborted instead of healing: "
+                        f"{e}")
+    finally:
+        loader.close()
+    kill_respawns = respawns() - before
+    if kill_respawns < 1:
+        problems.append("worker_kill: no respawn recorded")
+
+    before = respawns()
+    inj = TrainFaultInjector(parse_train_chaos_spec(f"seed={args.seed}"))
+    inj.force("worker_stall", [0] * 3 + [1])
+    loader = MPSampleLoader(ds, num_workers=2, seed=args.seed,
+                            transport="pickle", poll_timeout=0.3,
+                            stall_timeout=1.5, faults=inj, max_respawns=3)
+    it = iter(loader)
+    try:
+        for _ in range(16):
+            next(it)
+    except RuntimeError as e:
+        problems.append(f"worker_stall: loader aborted instead of healing: "
+                        f"{e}")
+    finally:
+        loader.close()
+    stall_respawns = respawns() - before
+    if stall_respawns < 1:
+        problems.append("worker_stall: no respawn recorded")
+    report["phases"]["workers"] = {"kill_respawns": kill_respawns,
+                                   "stall_respawns": stall_respawns}
+    print(f"[chaos] workers: kill healed by {int(kill_respawns)} respawn(s), "
+          f"stall by {int(stall_respawns)}, zero aborts")
+
+    # ---- verdict ---------------------------------------------------------
+    report["problems"] = problems
+    report["ok"] = not problems
+    verdict = out / "TRAIN_CHAOS.json"
+    verdict.write_text(json.dumps(report, indent=2, default=str) + "\n")
+    print(f"[chaos] verdict -> {verdict}")
+    if problems:
+        print("[chaos] TRAIN CHAOS FAIL: " + "; ".join(problems))
+        return 1
+    print("[chaos] TRAIN CHAOS PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
