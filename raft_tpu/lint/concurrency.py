@@ -89,6 +89,9 @@ SERVING_LOCK_HIERARCHY: Tuple[str, ...] = (
     "InferenceEngine._spec_lock", # leaf: feature-spec cache (under _lock on
                                   # the serve-time miss path)
     "FaultInjector._lock",        # leaf: chaos roll state
+    "SlotPool._lock",             # leaf: slot free-list + buffer refs,
+                                  # taken under the store lock on the
+                                  # promote/demote/sweep paths
 )
 
 

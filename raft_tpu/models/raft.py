@@ -207,7 +207,8 @@ def _iterate_flow(params, fmap1: jax.Array, fmap2: jax.Array,
                   net: jax.Array, inp: jax.Array, config: RAFTConfig,
                   iters: int, train: bool, all_flows: bool,
                   flow_init: Optional[jax.Array],
-                  policy_spec=None) -> RAFTOutput:
+                  policy_spec=None,
+                  active: Optional[jax.Array] = None) -> RAFTOutput:
     """The recurrent core of RAFT, from encoder features to flow.
 
     Shared by :func:`raft_forward` (which computes the features) and
@@ -220,6 +221,13 @@ def _iterate_flow(params, fmap1: jax.Array, fmap2: jax.Array,
     ``(policy, eps, min_iters)`` from :func:`_validate_loop_config` —
     public entries validate once, before their encoders, and pass it
     down; None validates here (direct/test callers).
+
+    ``active`` ([B] bool, optional) marks real rows in a slot-padded
+    batch (the batched streaming step): inactive rows start CONVERGED
+    under an adaptive policy — they never prolong the whole-batch
+    while_loop and report ``iters_used == 0`` — and their outputs are
+    discarded by the caller.  None (the default) = all rows real, and
+    every existing path is bit-for-bit unchanged.
     """
     policy, eps, min_iters = (policy_spec if policy_spec is not None
                               else _validate_loop_config(config))
@@ -359,6 +367,8 @@ def _iterate_flow(params, fmap1: jax.Array, fmap2: jax.Array,
             step, (net, coords1, mask0), None, length=iters,
             unroll=min(config.scan_unroll, iters))
         iters_used = jnp.full((B,), iters, jnp.int32)
+        if active is not None:             # padding rows spent nothing real
+            iters_used = jnp.where(active, iters_used, 0)
     else:
         # -- converge policy: per-sample masked freeze, static shapes -----
         # A sample whose update norm drops below eps is FROZEN: its carry
@@ -383,7 +393,11 @@ def _iterate_flow(params, fmap1: jax.Array, fmap2: jax.Array,
             nused = nused + active.astype(jnp.int32)
             return net, coords1, mask, converged, nused
 
-        conv0 = jnp.zeros((B,), bool)
+        # padding rows of a slot-batched step start converged: they can
+        # never extend the while_loop past the hardest REAL sample, and
+        # nused stays 0 for them (the padding-exclusion contract the
+        # serving metrics rely on)
+        conv0 = (jnp.zeros((B,), bool) if active is None else ~active)
         used0 = jnp.zeros((B,), jnp.int32)
         if train or all_flows:
             # differentiable form: masked scan over all `iters` iterations
@@ -483,7 +497,8 @@ def encode_frame(params: Dict[str, dict], image: jax.Array,
 def forward_from_features(params: Dict[str, dict], fmap1: jax.Array,
                           fmap2: jax.Array, cnet1: jax.Array,
                           config: RAFTConfig, iters: Optional[int] = None,
-                          flow_init: Optional[jax.Array] = None
+                          flow_init: Optional[jax.Array] = None,
+                          active: Optional[jax.Array] = None
                           ) -> RAFTOutput:
     """Run the recurrent flow core from PRECOMPUTED encoder features.
 
@@ -494,7 +509,9 @@ def forward_from_features(params: Dict[str, dict], fmap1: jax.Array,
     (ops/warmstart.warm_start_seed of the previous low-res flow) lets a
     ``converge:eps`` policy exit in a fraction of the cold iterations.
     Inference-only: the equivalent of ``raft_forward(train=False,
-    all_flows=False)`` on the frames the features came from.
+    all_flows=False)`` on the frames the features came from.  ``active``
+    ([B] bool) marks real rows of a slot-padded batch (see
+    :func:`_iterate_flow`); None = all rows real.
     """
     policy_spec = _validate_loop_config(config)
     params = _cast_params(params, config)
@@ -503,7 +520,7 @@ def forward_from_features(params: Dict[str, dict], fmap1: jax.Array,
     return _iterate_flow(params, fmap1, fmap2, net, inp, config,
                          iters=config.iters if iters is None else iters,
                          train=False, all_flows=False, flow_init=flow_init,
-                         policy_spec=policy_spec)
+                         policy_spec=policy_spec, active=active)
 
 
 def make_encode_fn(config: RAFTConfig):
@@ -534,6 +551,47 @@ def make_stream_step_fn(config: RAFTConfig, iters: Optional[int] = None):
                                     config, iters=iters, flow_init=flow_init)
         if adaptive:
             return out.flow, out.flow_lr, fmap_cur, cnet_cur, out.iters_used
+        return out.flow, out.flow_lr, fmap_cur, cnet_cur
+    return fn
+
+
+def make_stream_batch_step_fn(config: RAFTConfig,
+                              iters: Optional[int] = None):
+    """A jittable CONTINUOUS-BATCHED streaming step over a device-resident
+    slot pool: ``(params, images [b,H,W,3], fmap_buf [cap+1,h,w,C],
+    cnet_buf [cap+1,h,w,D], flow_buf [cap+1,h,w,2], slots [b] int32,
+    active [b] bool) -> (flow [b,H,W,2], flow_lr [b,h,w,2],
+    fmap_cur [b,h,w,C], cnet_cur [b,h,w,D][, iters_used [b]])``.
+
+    ONE device call advances ``b`` *different* sessions by one frame
+    each (LLM-continuous-batching applied to RAFT's cached maps — the
+    Ragged-Paged-Attention recipe from PAPERS.md): each row gathers its
+    session's cached previous-frame maps and warm-start seed from its
+    batch slot (``buf[slots]``), the current frames encode at batch
+    width ``b`` (one fnet pass per frame, exactly as the solo step), and
+    the recurrent core runs once for the whole batch.  Padding rows
+    carry ``active=False``: they point at the pool's scratch slot, start
+    converged under an adaptive policy (never extending the while_loop),
+    and report ``iters_used == 0``.  The updated maps come back as ROWS
+    — the caller commits the finite ones into the pool with the
+    scatter executable (serving/session.py ``make_slot_commit_fn``)
+    AFTER the host-side non-finite sentinel, so a poisoned row can
+    never be cached.
+    """
+    from ..config import adaptive_iters
+    adaptive = adaptive_iters(config.iters_policy)
+
+    def fn(params, images, fmap_buf, cnet_buf, flow_buf, slots, active):
+        fmap_cur, cnet_cur = encode_frame(params, images, config)
+        fmap_prev = fmap_buf[slots]
+        cnet_prev = cnet_buf[slots]
+        flow_init = flow_buf[slots]
+        out = forward_from_features(params, fmap_prev, fmap_cur, cnet_prev,
+                                    config, iters=iters,
+                                    flow_init=flow_init, active=active)
+        if adaptive:
+            return (out.flow, out.flow_lr, fmap_cur, cnet_cur,
+                    out.iters_used)
         return out.flow, out.flow_lr, fmap_cur, cnet_cur
     return fn
 

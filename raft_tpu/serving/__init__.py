@@ -21,6 +21,6 @@ from .metrics import (Counter, Gauge, Histogram, Registry,
 from .queue import (DeadlineExceeded, Draining, QueueFull, RejectedError,
                     Request, RequestQueue)
 from .server import BatcherSupervisor, FlowServer, serve_cli
-from .session import Session, SessionStore
+from .session import Session, SessionStore, SlotPool
 from .stream import (SessionBusy, StreamCoordinator, StreamRequest,
                      UnknownSession)

@@ -30,9 +30,15 @@ Failure containment (SERVING.md "Failure modes & degradation ladder"):
   SystemExit are re-raised after failing the batch, never swallowed.
 
 Streaming steps (serving/stream.py) share this thread — ONE owner of the
-device — but execute per session via the injected ``stream_fn``: the
-queue keys them per session id, so a popped run is either all-pairwise
-(coalesced) or a single session's step, never a mix.
+device.  Session OPENS execute solo via the injected ``stream_fn`` (the
+queue keys them per session id: an open runs the encode executable and
+has nothing to coalesce with); ADVANCES key per bucket and coalesce
+across *different* sessions exactly like pairwise work — a popped run
+of them goes to ``stream_group_fn`` (the coordinator's continuous-
+batched step: one device call advances the whole group, per-row
+non-finite sentinel + degrade-to-cold heal inside).  A popped run is
+always homogeneous: all-pairwise, all-advances (one bucket), or one
+open — the keys guarantee it.
 
 Thread model (SERVING.md "Threading model"): the batcher deliberately
 holds **no lock of its own** — single ownership IS its synchronization.
@@ -81,20 +87,37 @@ class BatcherCrashed(RuntimeError):
     trace_status = tlm_spans.ERROR
 
 
+def _fresh_error(e: BaseException) -> BaseException:
+    """Clone a group-wide failure per waiter: the HTTP layer stamps the
+    request's trace id onto the exception it receives, so a SHARED
+    instance would cross-wire ids between co-batched clients.  A
+    constructor that rejects its own args (kwarg-only shutdown wrappers)
+    falls back to the shared instance — still a correct failure;
+    stamp-if-absent keeps the first trace id."""
+    try:
+        return type(e)(*e.args)
+    except Exception:
+        return e
+
+
 class MicroBatcher:
     def __init__(self, queue: RequestQueue, run_fn: Callable,
                  pad_batch_to: Callable[[int], int], max_batch: int,
                  max_wait_ms: float, metrics: Optional[Dict] = None,
                  stream_fn: Optional[Callable] = None,
+                 stream_group_fn: Optional[Callable] = None,
                  breaker=None, faults=None, retries: int = 1,
                  retry_backoff_s: float = 0.02, on_crash=None):
         self.queue = queue
         self.run_fn = run_fn
         # streaming steps (serving/stream.py) ride the same queue and the
-        # same device-owning thread but execute per session: stream_fn
-        # takes ONE StreamRequest and returns (padded flow or None,
-        # iters_used or None)
+        # same device-owning thread: stream_fn takes ONE StreamRequest
+        # (session open / solo fallback) and returns (padded flow or
+        # None, iters_used or None); stream_group_fn takes a coalesced
+        # LIST of same-bucket advances and returns per-row
+        # (flow, iters_used, err) tuples (the continuous-batched path)
         self.stream_fn = stream_fn
+        self.stream_group_fn = stream_group_fn
         self.pad_batch_to = pad_batch_to
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1000.0
@@ -154,13 +177,12 @@ class MicroBatcher:
                 f"{time.monotonic() - r.enqueued_at:.3f}s in queue"))
 
     def _execute_stream(self, r) -> None:
-        """One sessionful step (never coalesced: the queue keys stream
-        requests per session).  Batch-size/occupancy histograms are left
-        to pairwise batches — a stream step is definitionally batch 1 and
-        would only dilute the coalescing signal they exist to expose; it
-        gets its own ``raft_stream_step_*`` families instead (batch 1,
-        occupancy 1.0 — the measured baseline ROADMAP item 1's continuous
-        stream batching has to beat)."""
+        """One SOLO sessionful step — session opens (keyed per session:
+        nothing to coalesce with) and the no-group-executor fallback.
+        It observes the stream-step families at its real width (batch 1,
+        occupancy 1.0); coalesced advances go through
+        :meth:`_execute_stream_group` instead, which also folds into the
+        shared batch-size/occupancy histograms."""
         if self.stream_fn is None:
             r.fail(RuntimeError("stream request on a batcher without a "
                                 "stream executor"))
@@ -230,6 +252,124 @@ class MicroBatcher:
             self._observe("pairs", 1.0)
             r.resolve(unpad(flow[:1], r.pads)[0])
 
+    # -- continuous-batched stream advances --------------------------------
+
+    def _execute_stream_group(self, batch) -> None:
+        """A coalesced run of same-bucket stream ADVANCES: one batched
+        device call for the whole group (serving/stream.py
+        ``execute_group`` — per-row sentinel and degrade-to-cold heal
+        inside), then per-row resolve/fail here.  Folds into the SAME
+        batch-size/occupancy histograms as pairwise batches (a stream
+        step is now a first-class device batch) and reports the
+        ``raft_stream_step_*`` families at the group's real width."""
+        group = []
+        for r in batch:
+            if r.abandoned:
+                # the handler gave up waiting (already counted
+                # status=timeout) and released the session lock:
+                # executing now would mutate session state a retry may
+                # be racing — drop the row, keep its batch-mates
+                r.fail(DeadlineExceeded(
+                    f"stream step {r.id} abandoned by its handler"))
+                continue
+            group.append(r)
+        if not group:
+            return
+        n = len(group)
+        padded = self.pad_batch_to(min(n, self.max_batch))
+        traced = [r for r in group if r.trace is not None]
+        t_form1 = time.monotonic()
+        for r in traced:
+            r.trace.span("queue_wait", r.enqueued_at, r.dequeued_at)
+            r.trace.span("batch_form", r.dequeued_at, t_form1, group=n)
+        self._observe("inflight", 1)
+        if traced:
+            tlm_spans.set_device_slot([])
+        t0 = time.monotonic()
+        err, outcomes = None, None
+        try:
+            outcomes = self.stream_group_fn(group)
+        except BaseException as e:
+            # the group executor contains per-row failures itself; an
+            # exception escaping it is a crash or a shutdown signal —
+            # fail every row (fresh same-type instance each: the HTTP
+            # layer stamps per-request trace ids), then let
+            # KeyboardInterrupt / SystemExit keep propagating
+            err = e
+        calls = tlm_spans.take_device_slot() if traced else ()
+        t1 = time.monotonic()
+        self._observe("inflight", -1)
+        self._observe("batch_latency", t1 - t0)
+        self._observe("stream_step_seconds", t1 - t0)
+        if err is None:
+            # honest device-step accounting: only rows whose result came
+            # from the batched call report its width (r.warm, set by the
+            # coordinator); demoted/healed rows ran solo cold restarts
+            # and report width-1 steps — raft_stream_step_batch and the
+            # shared batch histograms can never claim coalescing the
+            # device didn't actually do
+            warm_rows = sum(1 for r in group if r.warm)
+            cold_rows = n - warm_rows
+            if warm_rows:
+                self._observe("stream_steps")
+                self._observe("stream_step_batch", float(warm_rows))
+                self._observe("stream_step_occupancy", warm_rows / padded)
+                self._observe("batch_size", float(warm_rows))
+                self._observe("batch_occupancy", warm_rows / padded)
+            if cold_rows:
+                self._observe("stream_steps", cold_rows)
+                for _ in range(cold_rows):
+                    self._observe("stream_step_batch", 1.0)
+                    self._observe("stream_step_occupancy", 1.0)
+        exec_sid = tlm_spans.new_span_id()
+
+        def _exec_span(tr, status):
+            tr.span("execute", t0, t1, status=status, span_id=exec_sid,
+                    batch_real=n, batch_padded=padded)
+            for kind, c0, c1, c2 in calls or ():
+                tr.span("execute_dispatch", c0, c1, parent=exec_sid,
+                        call=kind)
+                tr.span("execute_block", c1, c2, parent=exec_sid,
+                        call=kind)
+
+        if err is not None:
+            if self.breaker is not None:
+                self.breaker.record(False)
+            for r in group:
+                if r.trace is not None:
+                    _exec_span(r.trace, tlm_spans.status_of(err))
+                self._observe("requests", "error", 1)
+                r.fail(_fresh_error(err))
+            if not isinstance(err, Exception):
+                raise err
+            return
+        now = time.monotonic()
+        served = 0
+        for r, (flow, iters_used, rerr) in zip(group, outcomes):
+            self._observe("queue_latency", r.dequeued_at - r.enqueued_at)
+            self._observe("request_latency", now - r.enqueued_at)
+            r.batch_real, r.batch_padded = n, padded
+            if rerr is not None:
+                status = ("poisoned"
+                          if getattr(rerr, "trace_status", None)
+                          == tlm_spans.POISONED else "error")
+                if r.trace is not None:
+                    _exec_span(r.trace, tlm_spans.status_of(rerr))
+                self._observe("requests", status, 1)
+                r.fail(rerr)
+                continue
+            if r.trace is not None:
+                _exec_span(r.trace, tlm_spans.OK)
+            if iters_used is not None:
+                r.iters_used = int(iters_used)
+                self._observe("iters_used", float(r.iters_used))
+            self._observe("requests", "ok", 1)
+            self.served += 1
+            served += 1
+            r.resolve(unpad(flow[:1], r.pads)[0])
+        if served:
+            self._observe("pairs", float(served))
+
     # -- pairwise execution: retry -> bisect -> sentinel -------------------
 
     def _bisect_budget(self, n: int) -> int:
@@ -239,9 +379,13 @@ class MicroBatcher:
         return (self.retries + 1) * 2 * n
 
     def _execute(self, batch) -> None:
-        if getattr(batch[0], "stream_op", None) is not None:
-            for r in batch:
-                self._execute_stream(r)
+        op = getattr(batch[0], "stream_op", None)
+        if op is not None:
+            if op == "advance" and self.stream_group_fn is not None:
+                self._execute_stream_group(batch)
+            else:
+                for r in batch:
+                    self._execute_stream(r)
             return
         n = len(batch)
         padded = self.pad_batch_to(min(n, self.max_batch))
@@ -301,8 +445,7 @@ class MicroBatcher:
                 # shutdown (KeyboardInterrupt/SystemExit): fail the group
                 # so no handler hangs, then keep propagating — swallowing
                 # it here would eat Ctrl-C.  Same type per waiter, but a
-                # FRESH instance each: the HTTP layer stamps the
-                # request's trace id onto the exception it receives
+                # FRESH instance each (_fresh_error)
                 t_x = time.monotonic()
                 tlm_spans.take_device_slot()
                 sid = tlm_spans.new_span_id()
@@ -312,15 +455,7 @@ class MicroBatcher:
                                      status=tlm_spans.ERROR, span_id=sid,
                                      batch_real=n, batch_padded=padded)
                     self._observe("requests", "error", 1)
-                    try:
-                        fresh = type(e)(*e.args)
-                    except Exception:
-                        # constructor rejects its own args (kwarg-only
-                        # shutdown wrappers): the shared instance is still
-                        # a correct failure — stamp-if-absent keeps the
-                        # first trace id
-                        fresh = e
-                    r.fail(fresh)
+                    r.fail(_fresh_error(e))
                 raise
             if self.breaker is not None:
                 self.breaker.record(True)

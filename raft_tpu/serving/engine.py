@@ -36,13 +36,20 @@ class InferenceEngine:
     """(kind, bucket, batch, iters-policy) -> compiled executable, with
     hit/miss accounting.  ``kind`` is ``"pair"`` (the /v1/flow two-frame
     executable), ``"encode"`` (single-frame fnet+cnet — session open /
-    cold restart), or ``"stream"`` (one-encoder sessionful step); the
-    streaming kinds share the cache, the warmup pass, and the no-recompile
-    discipline with the pairwise grid.  With
-    ``iters_policy='converge:...'`` (ServeConfig override or model-config
-    default) flow-producing executables return (…, iters_used): per-sample
-    early exit runs INSIDE the compiled while_loop, so shapes — and
-    therefore the warm compile grid — never change with the data.
+    cold restart), ``"stream"`` (one-encoder sessionful step, the cold
+    batch-1 form), or one of the slot-pool family — ``"sbatch"`` (the
+    CONTINUOUS-BATCHED stream step: b different sessions advanced in one
+    call, gathering cached maps from their pool slots), ``"scommit"``
+    (masked scatter of updated rows back into the pool buffers),
+    ``"szero"`` (fresh zeroed buffers, built at warmup so a pool reset
+    never compiles) and ``"spoison"`` (chaos session arm: NaN one slot's
+    fmap row — warmed only when the injector is armed).  Every kind
+    shares the cache, the warmup pass, and the no-recompile discipline
+    with the pairwise grid.  With ``iters_policy='converge:...'``
+    (ServeConfig override or model-config default) flow-producing
+    executables return (…, iters_used): per-sample early exit runs
+    INSIDE the compiled while_loop, so shapes — and therefore the warm
+    compile grid — never change with the data.
 
     Thread model (SERVING.md "Threading model"): device calls arrive on
     the single batcher thread, but warmup runs on the server's start
@@ -52,7 +59,9 @@ class InferenceEngine:
     a dropped increment is a wrong benchmark), ``_spec_lock`` for the
     feature-spec cache (separate lock because the serve-time miss path
     compiles while holding ``_lock``, and a nested re-take of one
-    non-reentrant lock would deadlock — raftlint C3)."""
+    non-reentrant lock would deadlock — raftlint C3).  The slot pool is
+    only ever touched OUTSIDE the engine locks (pool._lock is a leaf of
+    the hierarchy)."""
 
     _exec = guarded_by("_lock")
     compile_hits = guarded_by("_lock")
@@ -64,7 +73,7 @@ class InferenceEngine:
 
     def __init__(self, config: RAFTConfig, params, sconfig: ServeConfig,
                  iters: Optional[int] = None, stream: bool = False,
-                 faults=None):
+                 faults=None, pool=None):
         import jax
 
         # chaos harness (serving/faults.py): injected engine exceptions,
@@ -103,14 +112,32 @@ class InferenceEngine:
                     else make_inference_fn)
             self._fn = jax.jit(make(config, iters=iters))
         self.stream = stream
+        self.pool = pool                  # session.SlotPool (stream servers)
         if stream:
             # the streaming executables are plain single-device jits even
-            # under --serve-dp (batch-1 session steps cannot shard over
-            # the data axis); they live in the same cache and warm grid
-            from ..models.raft import make_encode_fn, make_stream_step_fn
+            # under --serve-dp (batch-1 session steps / slot scatters
+            # cannot shard over the data axis); they live in the same
+            # cache and warm grid
+            from ..models.raft import (make_encode_fn,
+                                       make_stream_batch_step_fn,
+                                       make_stream_step_fn)
+            from .session import (SlotPool, make_slot_commit_fn,
+                                  make_slot_poison_fn)
+            if self.pool is None:
+                self.pool = SlotPool(max(1, sconfig.max_sessions))
             self._encode_fn = jax.jit(make_encode_fn(config))
             self._stream_fn = jax.jit(make_stream_step_fn(config,
                                                           iters=iters))
+            self._sbatch_fn = jax.jit(make_stream_batch_step_fn(
+                config, iters=iters))
+            # the pool buffers are DONATED into the scatter executables so
+            # a commit updates rows in place (off-CPU; the CPU backend has
+            # no donation, so skip it there and keep warmup logs quiet)
+            donate = (() if jax.default_backend() == "cpu" else (0, 1, 2))
+            self._scommit_fn = jax.jit(make_slot_commit_fn(),
+                                       donate_argnums=donate)
+            self._spoison_fn = jax.jit(make_slot_poison_fn(),
+                                       donate_argnums=donate[:1])
             self._feature_specs: Dict[Tuple[int, int, int], tuple] = {}
             self._spec_lock = watched_lock("InferenceEngine._spec_lock")
         # budget None: a cold cache miss compiles while holding the lock
@@ -157,6 +184,19 @@ class InferenceEngine:
                 spec = self._feature_specs.setdefault(key, spec)
         return spec
 
+    def _slot_specs(self, h: int, w: int):
+        """ShapeDtypeStructs of this bucket's pool buffers ([cap+1, …] —
+        the extra row is the scratch slot padding rows aim at), derived
+        from the same eval_shape'd feature specs as the stream kinds."""
+        import jax
+        import jax.numpy as jnp
+        fs, cs = self._feature_shapes(h, w, 1)
+        cap1 = self.pool.capacity + 1
+        return (jax.ShapeDtypeStruct((cap1,) + fs.shape[1:], fs.dtype),
+                jax.ShapeDtypeStruct((cap1,) + cs.shape[1:], cs.dtype),
+                jax.ShapeDtypeStruct((cap1, h // 8, w // 8, 2),
+                                     jnp.float32))
+
     def _compile(self, key: Tuple[str, int, int, int, str]):
         import jax
         import jax.numpy as jnp
@@ -167,11 +207,31 @@ class InferenceEngine:
             return self._fn.lower(self.params, img, img).compile()
         if kind == "encode":
             return self._encode_fn.lower(self.params, img).compile()
-        assert kind == "stream", kind
-        fmap_s, cnet_s = self._feature_shapes(h, w, b)
-        flow_s = jax.ShapeDtypeStruct((b, h // 8, w // 8, 2), jnp.float32)
-        return self._stream_fn.lower(self.params, img, fmap_s, cnet_s,
-                                     flow_s).compile()
+        if kind == "stream":
+            fmap_s, cnet_s = self._feature_shapes(h, w, b)
+            flow_s = jax.ShapeDtypeStruct((b, h // 8, w // 8, 2),
+                                          jnp.float32)
+            return self._stream_fn.lower(self.params, img, fmap_s, cnet_s,
+                                         flow_s).compile()
+        fbuf, cbuf, flbuf = self._slot_specs(h, w)
+        idx = jax.ShapeDtypeStruct((b,), jnp.int32)
+        mask = jax.ShapeDtypeStruct((b,), jnp.bool_)
+        if kind == "sbatch":
+            return self._sbatch_fn.lower(self.params, img, fbuf, cbuf,
+                                         flbuf, idx, mask).compile()
+        if kind == "scommit":
+            fs, cs = self._feature_shapes(h, w, b)
+            seeds = jax.ShapeDtypeStruct((b, h // 8, w // 8, 2),
+                                         jnp.float32)
+            return self._scommit_fn.lower(fbuf, cbuf, flbuf, idx, fs, cs,
+                                          seeds, mask).compile()
+        if kind == "spoison":
+            return self._spoison_fn.lower(fbuf, idx).compile()
+        assert kind == "szero", kind
+        shapes = self._slot_specs(h, w)
+        zero = jax.jit(lambda: tuple(jnp.zeros(s.shape, s.dtype)
+                                     for s in shapes))
+        return zero.lower().compile()
 
     def _get_executable(self, key: Tuple[int, int, int, str]):
         with self._lock:
@@ -198,10 +258,22 @@ class InferenceEngine:
         grid = [(h, w, b, "pair") for (h, w) in self.sconfig.buckets
                 for b in self.sconfig.batch_steps]
         if self.stream:
-            # streaming executables run at batch 1 (one session step per
-            # device call); encode covers session open + cold restart
+            # encode covers session open + cold restart; "stream" is the
+            # cold batch-1 step; the continuous-batched step + its commit
+            # scatter warm at every declared batch width — PLUS width 1
+            # for "scommit" regardless (commit_row — session open / cold
+            # attach — always runs at width 1, and under --serve-dp the
+            # declared steps are multiples of N, never 1); "szero" builds
+            # the pool buffers (so a lazy/reset fill never compiles);
+            # "spoison" only exists for chaos drills
             grid += [(h, w, 1, kind) for (h, w) in self.sconfig.buckets
-                     for kind in ("encode", "stream")]
+                     for kind in ("encode", "stream", "szero", "scommit")]
+            grid += [(h, w, b, kind) for (h, w) in self.sconfig.buckets
+                     for b in self.sconfig.batch_steps
+                     for kind in ("sbatch", "scommit")]
+            if self.faults is not None:
+                grid += [(h, w, 1, "spoison")
+                         for (h, w) in self.sconfig.buckets]
         for (h, w, b, kind) in grid:
             key = self._key(h, w, b, kind)
             with self._lock:
@@ -216,6 +288,27 @@ class InferenceEngine:
                           f"({time.monotonic() - t0:.1f}s elapsed)")
         self.warmup_seconds = time.monotonic() - t0
         return n
+
+    def _ensure_slot_buffers(self, bucket: Tuple[int, int]) -> None:
+        """Build this bucket's pool buffers via the warmed ``szero``
+        executable, LAZILY on the bucket's first stream call: buffers
+        are (capacity+1) rows of fmap+cnet+seed PER BUCKET, so eager
+        allocation at warmup would cost num_buckets x that in device
+        memory before a single session opens.  szero is compiled at
+        warmup, so the lazy fill executes a warm executable — no
+        serve-time compile (a --no-warmup server pays one counted
+        compile here instead)."""
+        if self.pool.buffers(bucket) is None:
+            self.reset_slots(bucket)
+
+    def reset_slots(self, bucket: Tuple[int, int]) -> None:
+        """(Re)install zeroed pool buffers for a bucket — warmup fill,
+        and the recovery path after a failed commit scatter (whose
+        donated inputs are dead): the coordinator demotes every session
+        of the bucket right after, so no one ever gathers the zeros."""
+        h, w = bucket
+        ex = self._get_executable(self._key(h, w, 1, "szero"))
+        self.pool.install(bucket, ex())
 
     @property
     def executables(self) -> int:
@@ -309,3 +402,97 @@ class InferenceEngine:
         if self.faults is not None:
             flow = self.faults.corrupt_rows(flow)
         return flow, flow_lr, fmap, cnet, iters_used
+
+    # -- the continuous-batched stream path (slot pool) --------------------
+
+    def run_stream_batch(self, bucket: Tuple[int, int], images: np.ndarray,
+                         slots: np.ndarray, active: np.ndarray):
+        """ONE device call advancing ``active.sum()`` different sessions:
+        ``images`` [b, BH, BW, 3] (padded to a declared batch step),
+        ``slots`` [b] int32 pool rows (padding rows aim at the scratch
+        slot), ``active`` [b] bool.  Returns ``(flow [b] np, flow_lr [b]
+        np, fmap_rows dev, cnet_rows dev, iters_used [b] np or None)`` —
+        the updated map ROWS stay device-resident until
+        :meth:`commit_stream` scatters the finite ones into the pool.
+        ``stream_calls`` counts REAL rows (per-frame fnet accounting, the
+        acceptance counters)."""
+        h, w = bucket
+        b = images.shape[0]
+        self._ensure_slot_buffers(bucket)
+        ex = self._get_executable(self._key(h, w, b, "sbatch"))
+        with self._lock:
+            self.stream_calls += int(np.asarray(active).sum())
+        if self.faults is not None:
+            self.faults.pre_engine_call()
+        fbuf, cbuf, flbuf = self.pool.buffers(bucket)
+        t0 = time.monotonic()
+        out = ex(self.params, images, fbuf, cbuf, flbuf,
+                 np.asarray(slots, np.int32), np.asarray(active, bool))
+        t1 = time.monotonic()
+        if self.adaptive:
+            flow, flow_lr, fmap_rows, cnet_rows, iters_used = out
+            iters_used = np.asarray(iters_used)
+        else:
+            flow, flow_lr, fmap_rows, cnet_rows = out
+            iters_used = None
+        flow = np.asarray(flow)
+        flow_lr = np.asarray(flow_lr)
+        tlm_spans.record_device_call("stream", t0, t1, time.monotonic())
+        if self.faults is not None:
+            # chaos must poison a REAL row: padding rows (the suffix, by
+            # the coordinator's construction) are discarded before the
+            # sentinel, so a roll landing there would silently test
+            # nothing
+            n_real = int(np.asarray(active).sum())
+            flow = np.concatenate(
+                [self.faults.corrupt_rows(flow[:n_real]), flow[n_real:]])
+        return flow, flow_lr, fmap_rows, cnet_rows, iters_used
+
+    def commit_stream(self, bucket: Tuple[int, int], slots: np.ndarray,
+                      fmap_rows, cnet_rows, seeds: np.ndarray,
+                      mask: np.ndarray) -> None:
+        """Scatter updated rows into the pool buffers (masked: padding
+        rows and sentinel-rejected rows write their old value back) and
+        swap the pool refs.  The buffers were donated into the
+        executable (off-CPU), so the swap is mandatory — the old refs
+        are dead.  A commit that RAISES leaves the donated inputs in an
+        undefined state, so the buffers are rebuilt zeroed here before
+        the exception propagates; the caller must then demote the
+        bucket's sessions (``store.demote_bucket``) so nothing gathers
+        the zeros."""
+        h, w = bucket
+        b = int(np.asarray(slots).shape[0])
+        self._ensure_slot_buffers(bucket)
+        ex = self._get_executable(self._key(h, w, b, "scommit"))
+        fbuf, cbuf, flbuf = self.pool.buffers(bucket)
+        t0 = time.monotonic()
+        try:
+            out = ex(fbuf, cbuf, flbuf, np.asarray(slots, np.int32),
+                     fmap_rows, cnet_rows, np.asarray(seeds, np.float32),
+                     np.asarray(mask, bool))
+        except Exception:
+            self.reset_slots(bucket)
+            raise
+        self.pool.install(bucket, out)
+        # commit is dispatch-only: the rows stay device-resident
+        tlm_spans.record_device_call("commit", t0, time.monotonic(),
+                                     time.monotonic())
+
+    def commit_row(self, bucket: Tuple[int, int], slot: int, fmap, cnet,
+                   seed: np.ndarray) -> None:
+        """Width-1 commit: install one session's fresh maps + warm-start
+        seed into its slot (session open / cold-restart attach)."""
+        self.commit_stream(bucket, np.asarray([slot], np.int32),
+                           fmap, cnet, seed, np.asarray([True]))
+
+    def poison_slot(self, bucket: Tuple[int, int], slot: int) -> None:
+        """Chaos ``session`` arm: NaN one slot's cached fmap row in place
+        (drills only — the executable is warmed only when the injector is
+        armed)."""
+        h, w = bucket
+        self._ensure_slot_buffers(bucket)
+        ex = self._get_executable(self._key(h, w, 1, "spoison"))
+        fbuf, cbuf, flbuf = self.pool.buffers(bucket)
+        self.pool.install(bucket,
+                          (ex(fbuf, np.asarray([slot], np.int32)),
+                           cbuf, flbuf))

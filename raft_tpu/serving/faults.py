@@ -222,15 +222,18 @@ class FaultInjector:
         flow[row] = np.nan
         return flow
 
-    def corrupt_session(self, session) -> None:
+    def corrupt_session(self, session, engine) -> None:
         """Poison a stream session's cached device feature map with NaN
-        when the ``session`` arm fires — the NaNs propagate through the
-        correlation volume into the flow output, which the non-finite
-        sentinel must then catch and degrade to a cold restart."""
-        if session.fmap is None:
+        when the ``session`` arm fires — slot-pool form: the session's
+        fmap ROW in the pool buffer is NaN'd in place (the engine's
+        warmed ``spoison`` executable), so the poison rides the batched
+        gather into the correlation volume and the flow output, which
+        the non-finite sentinel must then catch and degrade that row to
+        a cold restart."""
+        if session.slot is None:
             return
         if self.roll("session"):
-            session.fmap = session.fmap * float("nan")
+            engine.poison_slot(session.bucket, session.slot)
 
     def maybe_kill(self) -> None:
         """Batcher-loop hook: raise :class:`BatcherKilled` when the

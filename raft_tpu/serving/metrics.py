@@ -85,11 +85,15 @@ def make_serving_metrics(registry: Registry, config,
     }
 
 
-def make_stream_metrics(registry: Registry, store) -> Dict[str, _Metric]:
+def make_stream_metrics(registry: Registry, store,
+                        buckets=None) -> Dict[str, _Metric]:
     """The streaming (/v1/stream) metric families — one definition site,
     same contract as :func:`make_serving_metrics`.  The session gauges are
     live callbacks on the store; the eviction counter is handed back to
-    the store so it can label the reason at the decision site."""
+    the store so it can label the reason at the decision site.
+    ``buckets`` (the declared resolution buckets) wires the per-bucket
+    slot-pool gauges — slots in use vs capacity, the device-memory
+    utilization of the continuous-batching stream path."""
     m = {
         "sessions_active": registry.gauge(
             "raft_stream_sessions_active",
@@ -126,22 +130,22 @@ def make_stream_metrics(registry: Registry, store) -> Dict[str, _Metric]:
             "Stream advances whose warm step faulted (engine error or "
             "non-finite output) and were transparently retried through "
             "the cold-restart path"),
-        # the stream-path occupancy gap (ROADMAP item 1): stream steps
-        # execute per session outside the pairwise batch histograms, so
-        # they get their own families — the measured baseline (batch 1,
-        # occupancy 1.0 today) continuous stream batching has to beat
+        # the continuous-batching observables (ROADMAP item 1): stream
+        # device steps now coalesce across sessions, so these report the
+        # REAL per-step width (batched advances also fold into the
+        # shared raft_serving_batch_size/occupancy histograms)
         "steps": registry.counter(
             "raft_stream_steps_total",
-            "Stream device steps executed (session opens + advances — "
-            "each one device call today)"),
+            "Stream device steps executed (one per device call: a "
+            "coalesced multi-session advance counts once)"),
         "step_seconds": registry.histogram(
             "raft_stream_step_seconds",
-            "Device time per stream step (the per-session serialization "
-            "ROADMAP item 1's continuous stream batching attacks)"),
+            "Device time per stream step (one batched step advances "
+            "every coalesced session)"),
         "step_batch": registry.histogram(
             "raft_stream_step_batch",
-            "Sessions coalesced per stream device step (1 until stream "
-            "steps batch across sessions)",
+            "Sessions coalesced per stream device step (continuous "
+            "batching width; 1 = a solo step / session open)",
             buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0)),
         "step_occupancy": registry.histogram(
             "raft_stream_step_occupancy",
@@ -150,6 +154,22 @@ def make_stream_metrics(registry: Registry, store) -> Dict[str, _Metric]:
             buckets=tuple(i / 10 for i in range(1, 11))),
     }
     store.evictions = m["evictions"]
+    if buckets:
+        pool = store.pool
+        in_use = registry.gauge(
+            "raft_stream_slots_in_use",
+            "Device-resident slot-pool rows allocated per bucket "
+            "(sessions whose maps sit in batch slots, ready to coalesce)",
+            labelnames=("bucket",))
+        cap = registry.gauge(
+            "raft_stream_slot_capacity",
+            "Slot-pool rows declared per bucket (--max-sessions)",
+            labelnames=("bucket",))
+        for (h, w) in buckets:
+            in_use.labels(f"{h}x{w}").set_fn(
+                functools.partial(pool.in_use, (h, w)))
+            cap.labels(f"{h}x{w}").set(pool.capacity)
+        m["slots_in_use"], m["slot_capacity"] = in_use, cap
     return m
 
 

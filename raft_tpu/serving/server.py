@@ -184,7 +184,8 @@ class FlowServer:
         self.streams = None
         if sconfig.max_sessions > 0:
             store = SessionStore(sconfig.max_sessions, sconfig.session_ttl_s)
-            stream_metrics = make_stream_metrics(self.registry, store)
+            stream_metrics = make_stream_metrics(self.registry, store,
+                                                 buckets=sconfig.buckets)
             self.streams = StreamCoordinator(
                 store, sconfig, self.queue, stream_metrics,
                 self.count_request, faults=self.faults,
@@ -195,14 +196,20 @@ class FlowServer:
             for k in ("steps", "step_seconds", "step_batch",
                       "step_occupancy"):
                 self.metrics[f"stream_{k}"] = stream_metrics[k]
-        # engine injection: tests drive the batching policy with stubs
+        # engine injection: tests drive the batching policy with stubs.
+        # A streaming engine shares the coordinator's slot pool: the
+        # store owns the alloc/free policy, the engine owns the device
+        # buffers and the warmed gather/scatter executables.
         self.engine = engine if engine is not None else InferenceEngine(
             config, params, sconfig, iters=iters,
-            stream=sconfig.max_sessions > 0, faults=self.faults)
+            stream=sconfig.max_sessions > 0, faults=self.faults,
+            pool=self.streams.pool if self.streams else None)
         self.batcher = MicroBatcher(
             self.queue, self._run_engine, sconfig.pad_batch_to,
             sconfig.max_batch, sconfig.max_wait_ms, metrics=self.metrics,
             stream_fn=self._run_stream if self.streams else None,
+            stream_group_fn=(self._run_stream_group if self.streams
+                             else None),
             breaker=self.breaker, faults=self.faults,
             retries=sconfig.engine_retries,
             retry_backoff_s=sconfig.retry_backoff_ms / 1000.0,
@@ -241,6 +248,23 @@ class FlowServer:
         before = getattr(self.engine, "compile_misses", None)
         with stage("serve/stream"):
             out = self.streams.execute(req, self.engine)
+        if before is not None:
+            after = self.engine.compile_misses
+            if after > before:
+                self.metrics["compile_misses"].inc(after - before)
+            else:
+                self.metrics["compile_hits"].inc()
+        return out
+
+    def _run_stream_group(self, group):
+        """Continuous-batched stream step (coalesced same-bucket
+        advances): one device batch, same trace window and compile-cache
+        accounting as the pairwise path."""
+        self._trace_window.on_step(self._device_batches)
+        self._device_batches += 1
+        before = getattr(self.engine, "compile_misses", None)
+        with stage("serve/stream"):
+            out = self.streams.execute_group(group, self.engine)
         if before is not None:
             after = self.engine.compile_misses
             if after > before:

@@ -2,29 +2,42 @@
 
 A *session* is one client's video stream.  Its state has two tiers:
 
-* **device tier** — the previous frame's encoder maps (``fmap`` + raw
-  ``cnet`` output, each ``[1, H/8, W/8, C]`` device-resident) and the
-  previous low-res flow (host, the warm-start seed).  This is what makes
-  the next advance cost ONE encoder pass and exit early under a
-  ``converge`` policy — and it is the expensive, scarce resource.
+* **device tier** — a SLOT in the per-bucket batch buffers of the
+  :class:`SlotPool`: the previous frame's encoder maps (``fmap`` + raw
+  ``cnet`` output) plus the pre-projected warm-start seed, each stored
+  as row ``session.slot`` of a ``[capacity+1, h, w, C]`` device-resident
+  buffer.  This is what makes the next advance cost ONE encoder pass and
+  exit early under a ``converge`` policy — and, because every session's
+  maps live *in batch slots* of one buffer, what lets the batcher
+  advance many sessions in ONE device call (the continuous-batching
+  stream step, models/raft.make_stream_batch_step_fn): gather rows by
+  slot index in, scatter updated rows back.
 * **host tier** — the previous frame's pixels plus bookkeeping.  Cheap,
   and exactly what a cold two-encoder restart needs.
 
-``SessionStore`` bounds both.  At most ``max_sessions`` sessions hold
-device features; promoting one past the cap *demotes* the least-recently-
-used holder (device tier dropped, host tier kept), so an advance on a
-demoted session degrades transparently to a cold two-encoder restart —
+``SessionStore`` keeps the host-side records and the LRU/TTL policy,
+mapping session id → slot index.  At most ``max_sessions`` sessions hold
+a slot; promoting one past the cap *demotes* the least-recently-used
+holder (slot freed back to the pool, host record kept), so an advance on
+a demoted session degrades transparently to a cold two-encoder restart —
 correct flow, no error, just the pairwise cost.  Session records
 themselves are capped at ``RECORD_CAP_FACTOR x max_sessions`` (oldest
 records evicted outright) and reaped entirely after ``ttl_s`` idle
-seconds; an advance on a reaped/unknown id is a 404 — the client reopens.
+seconds — TTL reaping FREES the reaped session's slot too, so a
+long-lived server can never strand device capacity behind dead records;
+an advance on a reaped/unknown id is a 404 — the client reopens.
 
 Thread model: handler threads open/advance/close under the store lock and
 hold the per-session lock across a whole advance (one frame in flight per
-session); feature attach/demote runs in the batcher thread.  A session
-may be demoted *between* enqueue and execute — the coordinator re-checks
-``has_features`` at execute time and falls back cold, which is the
-designed behavior, not a race.
+session); slot promote/demote runs in the batcher thread (via the store),
+and the pool's free-list is guarded by its own leaf lock
+(``SlotPool._lock``, taken under the store lock on demote/sweep paths —
+see SERVING_LOCK_HIERARCHY).  Device BUFFERS are read and swapped only on
+the single batcher thread (the engine's scatter executables), so buffer
+refs need the pool lock only to keep reads/swaps atomic against metric
+scrapes.  A session may be demoted *between* enqueue and execute — the
+coordinator re-checks ``has_features`` at execute time and falls back
+cold, which is the designed behavior, not a race.
 """
 
 from __future__ import annotations
@@ -32,7 +45,9 @@ from __future__ import annotations
 import time
 import uuid
 from collections import OrderedDict
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
+
+import numpy as np
 
 from ..lint.concurrency import guarded_by
 from ..telemetry.watchdogs import watched_lock
@@ -43,12 +58,137 @@ from ..telemetry.watchdogs import watched_lock
 RECORD_CAP_FACTOR = 4
 
 
+def make_slot_commit_fn():
+    """The slot-pool scatter: ``(fmap_buf, cnet_buf, flow_buf, slots [b],
+    fmap_rows [b,...], cnet_rows [b,...], seed_rows [b,...], mask [b])
+    -> (fmap_buf, cnet_buf, flow_buf)`` — rows with ``mask=True`` replace
+    their slot, everything else (padding rows aimed at the scratch slot,
+    rows the non-finite sentinel rejected) writes its OLD value back.
+
+    Scatter-duplicate discipline: real rows carry unique slot indices
+    (one frame in flight per session), and every masked row writes the
+    value it gathered — so duplicate indices (padding rows all share the
+    scratch slot) always write identical data and the scatter is
+    deterministic.  The serving engine compiles this per (bucket, width)
+    with the buffers DONATED (off-CPU), so a commit is an in-place row
+    update of the pool, not a buffer copy.
+    """
+    import jax.numpy as jnp
+
+    def fn(fmap_buf, cnet_buf, flow_buf, slots, fmap_rows, cnet_rows,
+           seed_rows, mask):
+        def put(buf, rows):
+            keep = mask.reshape((-1,) + (1,) * (rows.ndim - 1))
+            return buf.at[slots].set(jnp.where(keep, rows, buf[slots]))
+        return (put(fmap_buf, fmap_rows), put(cnet_buf, cnet_rows),
+                put(flow_buf, seed_rows))
+    return fn
+
+
+def make_slot_poison_fn():
+    """Chaos ``session`` arm, slot-pool form: NaN-poison one slot's fmap
+    row in place (``(fmap_buf, slots [1]) -> fmap_buf``) so the poison
+    propagates through the correlation volume into the flow output — the
+    non-finite sentinel must then catch it and degrade that row cold."""
+    import jax.numpy as jnp
+
+    def fn(fmap_buf, slots):
+        return fmap_buf.at[slots].multiply(jnp.nan)
+    return fn
+
+
+class SlotPool:
+    """Device-resident batch slots for the streaming sessions, per bucket.
+
+    Pure bookkeeping plus buffer references: a free-list of
+    ``capacity`` slot indices per bucket (index ``capacity`` is the
+    reserved SCRATCH row padding rows of a batched step aim at), and the
+    three device buffers (fmap / cnet / warm-start seed) the serving
+    engine's warmed executables gather from and scatter into.  The pool
+    itself never touches the device — buffers are created by the
+    engine's ``szero`` executable at warmup and swapped here after every
+    commit (functional update, donated off-CPU).
+
+    Thread model: the free-list mutates under ``_lock`` from the store's
+    promote/demote/sweep paths (store lock held — the declared
+    store → pool edge) and buffer refs swap on the single batcher
+    thread; the lock makes ref reads/swaps atomic for scrape-time
+    gauges.
+    """
+
+    _free = guarded_by("_lock")
+    _bufs = guarded_by("_lock")
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"slot pool capacity must be >= 1, "
+                             f"got {capacity}")
+        self.capacity = capacity
+        self.scratch = capacity          # the padding row, never allocated
+        self._lock = watched_lock("SlotPool._lock")
+        self._free: Dict[Tuple[int, int], list] = {}
+        self._bufs: Dict[Tuple[int, int], Optional[tuple]] = {}
+
+    @guarded_by("_lock")
+    def _bucket_locked(self, bucket: Tuple[int, int]) -> list:
+        free = self._free.get(bucket)
+        if free is None:
+            free = self._free.setdefault(bucket,
+                                         list(range(self.capacity - 1,
+                                                    -1, -1)))
+            self._bufs.setdefault(bucket, None)
+        return free
+
+    def alloc(self, bucket: Tuple[int, int]) -> Optional[int]:
+        """Pop a free slot index, or None when every slot of this bucket
+        is held by an in-flight session (the caller stays cold)."""
+        with self._lock:
+            free = self._bucket_locked(bucket)
+            return free.pop() if free else None
+
+    def free(self, bucket: Tuple[int, int], slot: int) -> None:
+        with self._lock:
+            self._bucket_locked(bucket).append(slot)
+
+    def in_use(self, bucket: Tuple[int, int]) -> int:
+        """Slots allocated in this bucket (the raft_stream_slots_in_use
+        gauge; scrape-time callback)."""
+        with self._lock:
+            free = self._free.get(bucket)
+            return 0 if free is None else self.capacity - len(free)
+
+    def buffers(self, bucket: Tuple[int, int]):
+        """(fmap_buf, cnet_buf, flow_buf) or None before install."""
+        with self._lock:
+            return self._bufs.get(bucket)
+
+    def install(self, bucket: Tuple[int, int], bufs: tuple) -> None:
+        """Install/swap this bucket's device buffers (batcher thread, or
+        engine warmup).  Called after every commit executable: the old
+        refs were donated and must never be used again."""
+        with self._lock:
+            self._bucket_locked(bucket)
+            self._bufs[bucket] = tuple(bufs)
+
+    def seed_row(self, bucket: Tuple[int, int],
+                 slot: int) -> Optional[np.ndarray]:
+        """Host copy of one slot's warm-start seed ([1, h, w, 2]) — the
+        solo cold/warm paths and tests read it; the batched step gathers
+        it in-device instead."""
+        bufs = self.buffers(bucket)
+        if bufs is None:
+            return None
+        return np.asarray(bufs[2][slot])[None]
+
+
 class Session:
     """One client stream's cached state.  Mutated only while its ``lock``
-    is held (handler thread) or from the batcher thread during execute."""
+    is held (handler thread) or from the batcher thread during execute.
+    Device-tier maps live in the slot pool at row ``slot``; the record
+    itself is host-side."""
 
     __slots__ = ("id", "bucket", "lock", "created_at", "last_used",
-                 "frames", "last_image", "fmap", "cnet", "prev_flow_lr")
+                 "frames", "last_image", "slot")
 
     def __init__(self, sid: str, bucket: Tuple[int, int]):
         self.id = sid
@@ -60,30 +200,30 @@ class Session:
         self.created_at = self.last_used = time.monotonic()
         self.frames = 0                  # advances served (pairs)
         self.last_image = None           # [1, BH, BW, 3] float32, host
-        self.fmap = None                 # [1, BH/8, BW/8, C] device
-        self.cnet = None                 # [1, BH/8, BW/8, D] device
-        self.prev_flow_lr = None         # [1, BH/8, BW/8, 2] float32, host
+        self.slot = None                 # pool slot index, or None (cold)
 
     @property
     def has_features(self) -> bool:
-        return self.fmap is not None
-
-    def drop_features(self) -> None:
-        self.fmap = self.cnet = self.prev_flow_lr = None
+        return self.slot is not None
 
 
 class SessionStore:
-    """LRU + TTL bounded session registry (one per FlowServer).
+    """LRU + TTL bounded session registry (one per FlowServer), mapping
+    sid → host record → pool slot index.
 
     ``_lock`` guards the registry itself (``_sessions`` order and
     membership); per-``Session`` state is serialized by ``Session.lock``
     plus the single batcher thread (see the module docstring).  The store
     only ever *probes* ``Session.lock.locked()`` under its own lock —
-    never acquires it — so the two can't order-invert."""
+    never acquires it — so the two can't order-invert.  Every slot
+    transition (promote / demote / sweep / close / record-cap evict)
+    happens under the store lock, so pool accounting can never leak a
+    slot behind a dropped record."""
 
     _sessions = guarded_by("_lock")
 
-    def __init__(self, max_sessions: int, ttl_s: float):
+    def __init__(self, max_sessions: int, ttl_s: float,
+                 pool: Optional[SlotPool] = None):
         if max_sessions < 1:
             raise ValueError(f"max_sessions must be >= 1 to build a store, "
                              f"got {max_sessions}")
@@ -92,17 +232,18 @@ class SessionStore:
         self.max_sessions = max_sessions
         self.record_cap = RECORD_CAP_FACTOR * max_sessions
         self.ttl_s = ttl_s
+        self.pool = pool if pool is not None else SlotPool(max_sessions)
         self._lock = watched_lock("SessionStore._lock")
         self._sessions: "OrderedDict[str, Session]" = OrderedDict()
         # set by make_stream_metrics: a labeled counter with reason=
-        # lru (features demoted), ttl (record reaped), capacity (record
+        # lru (slot demoted), ttl (record reaped), capacity (record
         # evicted outright).  None until wired — the store works bare.
         self.evictions = None
 
     # -- accounting (live gauge callbacks, sampled at scrape time) ---------
 
     def active_count(self) -> int:
-        """Sessions holding device features (the --max-sessions bound)."""
+        """Sessions holding a device slot (the --max-sessions bound)."""
         with self._lock:
             return sum(1 for s in self._sessions.values() if s.has_features)
 
@@ -115,10 +256,18 @@ class SessionStore:
         if self.evictions is not None:
             self.evictions.labels(reason).inc()
 
+    @guarded_by("_lock")
+    def _drop_slot_locked(self, s: Session) -> None:
+        """Free a session's slot back to the pool (store lock held — the
+        declared store → pool hierarchy edge)."""
+        if s.slot is not None:
+            self.pool.free(s.bucket, s.slot)
+            s.slot = None
+
     # -- lifecycle ---------------------------------------------------------
 
     def open(self, bucket: Tuple[int, int]) -> Session:
-        """Create a fresh session record (features attach on first
+        """Create a fresh session record (a slot attaches on first
         encode).  Enforces the record cap by evicting the oldest
         not-in-flight records outright."""
         s = Session(uuid.uuid4().hex, bucket)
@@ -141,35 +290,56 @@ class SessionStore:
             return s
 
     def close(self, sid: str) -> Optional[Session]:
+        """Pop the record and free its slot.  A session closed while its
+        advance is still in flight keeps the slot until the handler
+        releases the session lock and calls :meth:`reclaim_if_closed` —
+        freeing it mid-execute would let a new session's promote reuse a
+        row the batcher is about to scatter into."""
         with self._lock:
-            return self._sessions.pop(sid, None)
+            s = self._sessions.pop(sid, None)
+            if s is not None and not s.lock.locked():
+                self._drop_slot_locked(s)
+            return s
+
+    def reclaim_if_closed(self, s: Session) -> None:
+        """Handler-side epilogue of an advance: if the session was closed
+        (or reaped) while its frame was in flight, free the slot the
+        deferred close left behind."""
+        with self._lock:
+            if s.id not in self._sessions and not s.lock.locked():
+                self._drop_slot_locked(s)
 
     def sweep(self, now: Optional[float] = None) -> int:
-        """Reap records idle past the TTL (skipping in-flight sessions);
-        called opportunistically from the request path — no sweeper
-        thread to leak."""
+        """Reap records idle past the TTL (skipping in-flight sessions)
+        and FREE their device slots back to the pool — a reaped session
+        must never strand slot capacity; called opportunistically from
+        the request path — no sweeper thread to leak."""
         now = time.monotonic() if now is None else now
         reaped = 0
         with self._lock:
             for sid in [sid for sid, s in self._sessions.items()
                         if now - s.last_used > self.ttl_s
                         and not s.lock.locked()]:
-                self._sessions.pop(sid)
+                self._drop_slot_locked(self._sessions.pop(sid))
                 self._evict("ttl")
                 reaped += 1
         return reaped
 
-    # -- the device-feature bound -----------------------------------------
+    # -- the device-slot bound --------------------------------------------
 
-    def attach_features(self, session: Session, fmap, cnet,
-                        prev_flow_lr) -> None:
-        """Install a session's fresh device maps (batcher thread), then
-        demote LRU feature-holders until at most ``max_sessions`` remain —
-        the device-memory bound the store exists for."""
-        session.fmap, session.cnet = fmap, cnet
-        session.prev_flow_lr = prev_flow_lr
+    def promote(self, session: Session) -> Optional[int]:
+        """Give ``session`` a device slot (batcher thread, at commit
+        time): demote LRU slot-holders until a slot is free — the
+        device-memory bound the store exists for — then allocate.  A
+        session that already holds a slot keeps it (the common advance
+        path: its rows are updated in place by the commit scatter).
+        Returns the slot, or None when every slot is pinned by an
+        in-flight session (the caller stays cold — correct, just the
+        pairwise cost)."""
         with self._lock:
             session.last_used = time.monotonic()
+            if session.slot is not None:
+                return session.slot
             holders = [s for s in self._sessions.values()
                        if s.has_features and s is not session]
             excess = len(holders) + 1 - self.max_sessions
@@ -178,23 +348,56 @@ class SessionStore:
                     break
                 if s.lock.locked():      # mid-advance: not a demotion target
                     continue
-                s.drop_features()
+                self._drop_slot_locked(s)
                 self._evict("lru")
                 excess -= 1
+            session.slot = self.pool.alloc(session.bucket)
+            return session.slot
+
+    def demote(self, session: Session, reason: str) -> None:
+        """Drop one session's device slot (faulted warm step: the
+        degrade-to-cold rung of the ladder).  A no-op on an already-cold
+        session, so a bucket-wide recovery followed by per-row degrade
+        bookkeeping never double-counts an eviction."""
+        with self._lock:
+            if session.slot is not None:
+                self._drop_slot_locked(session)
+                self._evict(reason)
 
     def demote_all(self, reason: str = "degraded") -> int:
-        """Drop EVERY session's device features (records kept): the
-        circuit breaker's degrade hook.  When the breaker opens the
-        engine is sick — cached per-session device state from before the
-        storm is not worth trusting, and dropping it routes every
-        surviving session through the transparent cold-restart path once
-        the breaker closes (correct flow, pairwise cost, no error).
+        """Drop EVERY session's device slot (records kept): the circuit
+        breaker's degrade hook.  When the breaker opens the engine is
+        sick — cached per-session device state from before the storm is
+        not worth trusting, and dropping it routes every surviving
+        session through the transparent cold-restart path once the
+        breaker closes (correct flow, pairwise cost, no error).
         In-flight sessions are skipped, same as LRU demotion."""
         n = 0
         with self._lock:
             for s in self._sessions.values():
                 if s.has_features and not s.lock.locked():
-                    s.drop_features()
+                    self._drop_slot_locked(s)
+                    self._evict(reason)
+                    n += 1
+        return n
+
+    def demote_bucket(self, bucket: Tuple[int, int],
+                      reason: str = "degraded") -> int:
+        """Drop EVERY session slot of ONE bucket — in-flight sessions
+        INCLUDED.  This is the recovery hook after a failed commit
+        scatter rebuilt the bucket's (donated, now-dead) buffers zeroed:
+        any session keeping its slot would gather zeros on its next
+        advance and serve finite garbage, so the usual skip-the-locked
+        convention must not apply.  Safe to override it here: this runs
+        only on the single batcher thread — the one thread that gathers
+        — so no step can be mid-gather while the slots are dropped;
+        queued advances re-check ``has_features`` at execute time and
+        fall back cold."""
+        n = 0
+        with self._lock:
+            for s in self._sessions.values():
+                if s.bucket == bucket and s.has_features:
+                    self._drop_slot_locked(s)
                     self._evict(reason)
                     n += 1
         return n
@@ -203,5 +406,7 @@ class SessionStore:
     def _pop_lru_locked(self) -> Optional[Session]:
         for sid, s in self._sessions.items():
             if not s.lock.locked():
-                return self._sessions.pop(sid)
+                s = self._sessions.pop(sid)
+                self._drop_slot_locked(s)
+                return s
         return None

@@ -4,35 +4,58 @@ Protocol (wire format parsed in http.py): a client *opens* a session with
 its first frame, *advances* it one frame at a time — each advance returns
 flow(prev -> cur) — and *closes* it.  Per advance the server runs ONE
 encoder pass (the current frame's; the previous frame's fmap/context maps
-are cached device-side in the session) and warm-starts the recurrence
-from the previous flow forward-projected along itself
+are cached device-side in the session's SLOT of the per-bucket batch
+buffers — serving/session.SlotPool) and warm-starts the recurrence from
+the previous flow forward-projected along itself
 (ops/warmstart.warm_start_seed — RAFT's own Sintel video protocol), so a
 ``converge:eps`` iteration policy exits in a fraction of the cold count.
 
+**Continuous batching** (ROADMAP item 1, the Ragged-Paged-Attention
+recipe from PAPERS.md): advances are keyed per BUCKET in the admission
+queue, so concurrent stream steps from *different* sessions coalesce —
+up to max_batch / max_wait, exactly like pairwise requests — into ONE
+batched stream executable (models/raft.make_stream_batch_step_fn) that
+gathers each row's cached maps + warm-start seed from its pool slot,
+advances every session in one device call, and scatters the updated
+rows back.  Rows join and leave the batch every step as sessions open,
+advance and close; padding rows are inactive (scratch slot, converged
+from iteration 0, excluded from all metrics).  Session opens and the
+cold-restart path stay solo calls (keyed per session): they run the
+``encode`` executable, which has no batch-mates to share.
+
 Stream steps ride the SAME admission queue and batcher thread as
-``/v1/flow`` (bounded depth -> 429, deadlines -> 504, graceful drain),
-keyed per session so they never coalesce with pairwise batches; the
-session lock serializes frames within a session (a concurrent advance on
-the same session answers 409 rather than reordering the recurrence).
+``/v1/flow`` (bounded depth -> 429, deadlines -> 504, graceful drain);
+the session lock serializes frames within a session (a concurrent
+advance on the same session answers 409 rather than reordering the
+recurrence) — which is also why a coalesced group can never hold the
+same session twice, so the commit scatter's real slot indices are
+always unique.
 
 Thread model (SERVING.md "Threading model"): the handler thread holds
 ``Session.lock`` across the WHOLE advance — including ``queue.submit``
 (which takes the queue lock) and the blocking wait — which is why the
 declared hierarchy orders ``Session.lock`` OUTSIDE
 ``RequestQueue._lock``.  The coordinator itself holds no lock: session
-state is mutated only in :meth:`execute` on the batcher thread, while
-the handler's session lock keeps any second frame of the same session
-out; ``store._evict`` (a thread-safe counter inc) is the only store
-touch made without the store lock.
+state is mutated only in :meth:`execute`/:meth:`execute_group` on the
+batcher thread, while the handler's session lock keeps any second frame
+of the same session out; slot transitions go through the store
+(store lock → pool lock, the declared edge).
 
-Evicted (demoted) sessions degrade transparently: the advance re-encodes
-the retained previous frame — the cold two-encoder cost, the same flow.
+Failure containment, per ROW of a batched step: a warm row that faults —
+the batched call raising, or that row's output failing the non-finite
+sentinel (e.g. a poisoned slot) — is demoted and healed through the SAME
+transparent cold-restart path an evicted session takes, in the same
+advance; its co-batched neighbors keep their warm results.  This is the
+stream path's form of poisoned-row isolation: the pairwise path bisects
+because it has no finer fallback, the stream path degrades straight to
+per-row cold restarts (finer blame, bounded at two engine calls per
+row).  A cold attempt that faults is terminal for that frame only.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -58,16 +81,20 @@ class SessionBusy(RejectedError):
 
 
 class StreamRequest(Request):
-    """One stream step in flight.  ``bucket`` is the queue key — per
-    session, so the batcher pops stream steps alone, never coalesced with
-    pairwise work or other sessions."""
+    """One stream step in flight.  ``bucket`` is the queue key —
+    advances key per BUCKET (``("stream", H, W)``) so concurrent steps
+    from different sessions coalesce into one batched device call, while
+    opens key per session (``("stream-open", sid)``): they run the solo
+    encode executable and have nothing to coalesce with.  Neither key
+    ever collides with a pairwise ``(H, W)`` bucket."""
 
     __slots__ = ("session", "stream_op", "warm", "frame", "abandoned")
 
     def __init__(self, session: Session, op: str, image_padded, pads,
                  deadline: float):
-        super().__init__(image_padded, None, ("stream", session.id), pads,
-                         deadline)
+        key = (("stream",) + tuple(session.bucket) if op == "advance"
+               else ("stream-open", session.id))
+        super().__init__(image_padded, None, key, pads, deadline)
         self.session = session
         self.stream_op = op              # "open" | "advance"
         self.warm = False                # set at execute time
@@ -81,17 +108,20 @@ class StreamRequest(Request):
 
 
 class StreamCoordinator:
-    """Owns the session store and the stream-step device recipe.
+    """Owns the session store + slot pool policy and the stream-step
+    device recipe.
 
     Handler threads call :meth:`open`/:meth:`advance`/:meth:`close`
-    (validate, lock the session, enqueue, block); the batcher thread calls
-    :meth:`execute` (the only place device state moves).
+    (validate, lock the session, enqueue, block); the batcher thread
+    calls :meth:`execute` (opens) and :meth:`execute_group` (coalesced
+    advances) — the only places device state moves.
     """
 
     def __init__(self, store: SessionStore, sconfig, queue: RequestQueue,
                  metrics: Dict, count_fn, faults=None, nonfinite=None,
                  breaker=None, tracer=None):
         self.store = store
+        self.pool = store.pool
         self.sconfig = sconfig
         self.queue = queue
         self.metrics = metrics           # make_stream_metrics families
@@ -164,6 +194,9 @@ class StreamCoordinator:
                                  finish_trace=finish_trace)
         finally:
             s.lock.release()
+            # a close() that raced this advance deferred the slot free
+            # to us (see SessionStore.close)
+            self.store.reclaim_if_closed(s)
         meta = {"bucket": list(s.bucket), "warm": req.warm,
                 "batch_real": req.batch_real,
                 "batch_padded": req.batch_padded}
@@ -243,70 +276,168 @@ class StreamCoordinator:
     # -- batcher-thread API ------------------------------------------------
 
     def execute(self, req: StreamRequest, engine):
-        """Run one stream step on the device.  Returns (padded flow or
-        None, iters_used or None); all session/cache mutation happens
-        here, on the single thread that owns the device.
-
-        Degradation ladder (SERVING.md): a *warm* step that faults —
-        engine exception or a non-finite flow output (e.g. poisoned
-        cached maps) — drops the session's device features and retries
-        once through the SAME transparent cold-restart path an evicted
-        session already takes: two encoder passes, correct flow, no
-        error.  A cold step that faults is terminal for this frame (the
-        client retries; session state was not advanced)."""
+        """Run one SOLO stream step on the device (session open, or a
+        lone advance routed outside the group path).  Returns (padded
+        flow or None, iters_used or None); all session/cache mutation
+        happens here or in :meth:`execute_group`, on the single thread
+        that owns the device."""
         s = req.session
         if req.stream_op == "open":
             fmap, cnet = engine.run_encode(s.bucket, req.image1)
-            self.store.attach_features(s, fmap, cnet, None)
+            self._attach(s, engine, fmap, cnet, flow_lr=None)
             s.last_image = req.image1
             return None, None
-        if self.faults is not None:
-            self.faults.corrupt_session(s)   # chaos: session-map arm
-        warm = s.has_features
-        try:
-            flow, iters_used = self._advance_once(s, req, engine, warm)
-        except Exception:
-            # the failed warm call still counts against the breaker even
-            # though the advance will heal: it measures engine-call
-            # health, and a 100%-warm-failure mode must be visible (the
-            # batcher records only the advance's terminal outcome)
-            if self.breaker is not None:
-                self.breaker.record(False)
-            if not warm:
-                raise
-            s.drop_features()
-            self.store._evict("degraded")
-            self.metrics["degraded"].inc()
-            if req.trace is not None:
-                # the client gets a 200 but the trace says what it cost:
-                # degraded outranks ok and is always recorder-retained
-                req.trace.set_status(tlm_spans.DEGRADED)
-            flow, iters_used = self._advance_once(s, req, engine,
-                                                  warm=False)
-            warm = False
-        s.frames += 1
-        req.warm = warm
-        req.frame = s.frames
-        self.metrics["frames"].inc()
+        [(flow, iters_used, err)] = self.execute_group([req], engine)
+        if err is not None:
+            raise err
         return flow, iters_used
 
-    def _advance_once(self, s: Session, req: StreamRequest, engine,
-                      warm: bool):
-        """One advance attempt.  Session state (maps, last_image) is
+    def execute_group(self, group: List[StreamRequest], engine):
+        """Advance a coalesced same-bucket group of sessions: ONE batched
+        device call for the warm rows (gather slots → step → masked
+        commit), solo cold restarts for demoted rows and for warm rows
+        that faulted (the per-row degradation ladder — see the module
+        docstring).  Returns ``[(padded flow, iters_used, err)]`` aligned
+        with ``group``; exactly one of flow/err is set per row.  Session
+        host state (frames, last_image) moves only for rows that
+        succeeded."""
+        if self.faults is not None:
+            for r in group:
+                self.faults.corrupt_session(r.session, engine)
+        results: List[Optional[tuple]] = [None] * len(group)
+        warm_idx = [i for i, r in enumerate(group)
+                    if r.session.has_features]
+        heal_idx: List[int] = []
+        if warm_idx:
+            rows = self._warm_batch([group[i] for i in warm_idx], engine)
+            for i, row in zip(warm_idx, rows):
+                if row is None:          # faulted warm row: degrade, heal
+                    heal_idx.append(i)
+                else:
+                    results[i] = row
+        cold_idx = [i for i, r in enumerate(group)
+                    if not r.session.has_features and i not in heal_idx]
+        for i in sorted(cold_idx + heal_idx):
+            r = group[i]
+            try:
+                flow, iters_used = self._cold_advance(r.session, r, engine)
+                r.warm = False
+                if iters_used is not None:
+                    iters_used = int(np.asarray(iters_used).reshape(-1)[0])
+                results[i] = (flow, iters_used, None)
+            except Exception as e:
+                if self.breaker is not None:
+                    self.breaker.record(False)
+                results[i] = (None, None, e)
+        for r, (flow, _iters, err) in zip(group, results):
+            if err is None:
+                r.session.frames += 1
+                r.frame = r.session.frames
+                self.metrics["frames"].inc()
+        return results
+
+    def _warm_batch(self, reqs: List[StreamRequest], engine):
+        """One batched stream step over the warm rows.  Returns a list
+        aligned with ``reqs``: ``(padded flow, iters_used, None)`` for
+        rows whose output passed the sentinel (their slots are
+        committed), or None for rows that must heal cold (their slots
+        are dropped; nothing poisoned is ever cached)."""
+        s0 = reqs[0].session
+        bucket = s0.bucket
+        n = len(reqs)
+        padded = self.sconfig.pad_batch_to(min(n, self.sconfig.max_batch))
+        images = np.concatenate([r.image1 for r in reqs]
+                                + [reqs[-1].image1] * (padded - n))
+        slots = np.asarray([r.session.slot for r in reqs]
+                           + [self.pool.scratch] * (padded - n), np.int32)
+        active = np.asarray([True] * n + [False] * (padded - n), bool)
+        try:
+            flow, flow_lr, fmap_rows, cnet_rows, iters_used = \
+                engine.run_stream_batch(bucket, images, slots, active)
+        except Exception:
+            # the batched call itself faulted: every row degrades to the
+            # cold-restart path (the solo semantics, batched — no retry:
+            # a warm step has a finer fallback than re-running the whole
+            # group, and the cold heal isolates the guilty row).  The
+            # failed call still counts against the breaker: it measures
+            # engine-call health, and a 100%-warm-failure mode must stay
+            # visible even though every advance heals.
+            if self.breaker is not None:
+                self.breaker.record(False)
+            for r in reqs:
+                self._degrade(r)
+            return [None] * n
+        if self.breaker is not None:
+            self.breaker.record(True)
+        h, w = bucket
+        row_ok = np.array([np.isfinite(flow[i]).all()
+                           and np.isfinite(flow_lr[i]).all()
+                           for i in range(n)], bool)
+        # commit BEFORE touching host state, AFTER the sentinel: finite
+        # rows scatter their updated maps + next-frame warm-start seed
+        # into their slots; rejected and padding rows write their old
+        # values back (mask), so a poisoned output can never be cached
+        seeds = np.zeros((padded, h // 8, w // 8, 2), np.float32)
+        for i in np.flatnonzero(row_ok):
+            seeds[i] = warm_start_seed(flow_lr[i:i + 1],
+                                       (h // 8, w // 8))[0]
+        mask = active.copy()
+        mask[:n] &= row_ok
+        try:
+            engine.commit_stream(bucket, slots, fmap_rows, cnet_rows,
+                                 seeds, mask)
+        except Exception:
+            # a failed commit leaves the (donated) bucket buffers dead;
+            # commit_stream already rebuilt them zeroed — now demote
+            # EVERY session of the bucket, in-flight/queued ones
+            # included (demote_bucket overrides the skip-the-locked
+            # convention precisely because a kept slot would gather the
+            # zeros and serve finite garbage), then heal this group cold
+            self.store.demote_bucket(bucket)
+            for r in reqs:
+                self._degrade(r)
+            return [None] * n
+        out = []
+        for i, r in enumerate(reqs):
+            if not row_ok[i]:
+                if self.nonfinite is not None:
+                    self.nonfinite.inc()
+                log = tlm_events.current()
+                if log is not None:
+                    log.event("nonfinite_output", session=r.session.id,
+                              warm=True,
+                              trace_id=(r.trace.trace_id
+                                        if r.trace is not None else None))
+                self._degrade(r)
+                out.append(None)
+                continue
+            r.session.last_image = r.image1
+            r.warm = True
+            self.metrics["fnet_hits"].inc()
+            out.append((flow[i:i + 1],
+                        None if iters_used is None else int(iters_used[i]),
+                        None))
+        return out
+
+    def _degrade(self, req: StreamRequest) -> None:
+        """Drop one faulted warm row's slot so its heal (and every later
+        advance until re-promotion) runs the transparent cold-restart
+        path; the client still gets a 200, the trace says what it cost."""
+        self.store.demote(req.session, "degraded")
+        self.metrics["degraded"].inc()
+        if req.trace is not None:
+            # degraded outranks ok and is always recorder-retained
+            req.trace.set_status(tlm_spans.DEGRADED)
+
+    def _cold_advance(self, s: Session, req: StreamRequest, engine):
+        """Cold two-encoder restart from the retained previous frame —
+        pairwise cost, correct flow.  Session state (slot, last_image) is
         mutated only AFTER the output passes the non-finite sentinel, so
         a faulted attempt leaves the session exactly where it was."""
         H, W = s.bucket
-        if warm:
-            # ONE encoder pass this step: frame t's maps are resident
-            fmap_p, cnet_p = s.fmap, s.cnet
-            init = warm_start_seed(s.prev_flow_lr, (H // 8, W // 8))
-            self.metrics["fnet_hits"].inc()
-        else:
-            # demoted/degraded: cold two-encoder restart from the
-            # retained previous frame — pairwise cost, correct flow
-            fmap_p, cnet_p = engine.run_encode(s.bucket, s.last_image)
-            init = np.zeros((1, H // 8, W // 8, 2), np.float32)
-            self.metrics["fnet_misses"].inc()
+        fmap_p, cnet_p = engine.run_encode(s.bucket, s.last_image)
+        init = np.zeros((1, H // 8, W // 8, 2), np.float32)
+        self.metrics["fnet_misses"].inc()
         flow, flow_lr, fmap_c, cnet_c, iters_used = engine.run_stream(
             s.bucket, req.image1, fmap_p, cnet_p, init)
         if not (np.isfinite(flow).all() and np.isfinite(flow_lr).all()):
@@ -316,12 +447,32 @@ class StreamCoordinator:
                 self.nonfinite.inc()
             log = tlm_events.current()
             if log is not None:
-                log.event("nonfinite_output", session=s.id, warm=warm,
+                log.event("nonfinite_output", session=s.id, warm=False,
                           trace_id=(req.trace.trace_id
                                     if req.trace is not None else None))
             raise NonFiniteOutput(
                 f"non-finite stream output for session {s.id} on a "
-                f"{'warm' if warm else 'cold'} step")
-        self.store.attach_features(s, fmap_c, cnet_c, flow_lr)
+                f"cold step")
+        self._attach(s, engine, fmap_c, cnet_c, flow_lr)
         s.last_image = req.image1
         return flow, iters_used
+
+    def _attach(self, s: Session, engine, fmap, cnet, flow_lr) -> None:
+        """Install fresh maps + the next advance's warm-start seed into
+        the session's slot (promoting it — LRU demotion happens inside
+        the store if the pool is at capacity).  ``promote`` returning
+        None (every slot pinned by an in-flight session) leaves the
+        session cold: correct, just the pairwise cost next frame.  A
+        FAILED commit must not fail the advance either — the flow is
+        already computed and correct — but its donated buffers are dead
+        (rebuilt zeroed by the engine), so the whole bucket demotes
+        before anything can gather the zeros."""
+        slot = self.store.promote(s)
+        if slot is None:
+            return
+        H, W = s.bucket
+        seed = warm_start_seed(flow_lr, (H // 8, W // 8))
+        try:
+            engine.commit_row(s.bucket, slot, fmap, cnet, seed)
+        except Exception:
+            self.store.demote_bucket(s.bucket)

@@ -153,8 +153,8 @@ def test_breaker_open_demotes_stream_sessions():
                        cooldown_s=1.0,
                        on_open=lambda: opened.append(store.demote_all()))
     s1, s2 = store.open(BUCKET), store.open(BUCKET)
-    store.attach_features(s1, "f", "c", None)
-    store.attach_features(s2, "f", "c", None)
+    store.promote(s1)
+    store.promote(s2)
     with s2.lock:                       # s2 mid-advance: not demotable
         b.record(False)
         b.record(False)
@@ -605,12 +605,147 @@ def test_degraded_advance_trace_retained_and_fault_joinable(
         log.close()
 
 
+@pytest.fixture(scope="module")
+def chaos_group_server():
+    """A streaming server whose advances COALESCE (max_batch 2, wide
+    max_wait) with the injector built at zero rates — the group-path
+    chaos drills force exactly the faults they need."""
+    from raft_tpu.config import RAFTConfig, init_rng
+    from raft_tpu.models import init_raft
+
+    config = RAFTConfig.small_model(iters=2)
+    params = init_raft(init_rng(), config)
+    sconfig = ServeConfig(buckets=((32, 48),), max_batch=2,
+                          batch_steps=(1, 2), max_wait_ms=250.0,
+                          queue_depth=16, default_deadline_ms=30_000.0,
+                          port=0, max_sessions=4, session_ttl_s=600.0,
+                          chaos="seed=1", engine_retries=0)
+    server = FlowServer(config, params, sconfig)
+    server.start()
+    yield server
+    server.stop()
+
+
+def _coalesced_advance(server, sids, frames):
+    """Advance every session concurrently (barrier-released) so the
+    batcher pops them as ONE group; returns responses aligned with
+    sids."""
+    barrier = threading.Barrier(len(sids))
+    out, errs = [None] * len(sids), []
+
+    def adv(i):
+        try:
+            barrier.wait(timeout=10)
+            out[i] = _post_stream(server, {"session": sids[i],
+                                           "image": frames[i].tolist()})
+        except Exception as e:  # noqa: BLE001 — surfaced by the caller
+            errs.append(e)
+
+    threads = [threading.Thread(target=adv, args=(i,))
+               for i in range(len(sids))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    return out
+
+
+def test_group_nan_row_heals_alone(chaos_group_server):
+    """Chaos ``nan`` arm under the BATCHED stream path: one row of the
+    coalesced output goes NaN — the sentinel rejects exactly that row,
+    it heals through the cold path inside the same advance, and its
+    co-batched neighbor keeps its warm result.  Both clients see 200
+    with correct flow."""
+    server = chaos_group_server
+    rng = np.random.RandomState(60)
+    seqs = [[rng.rand(32, 48, 3).astype(np.float32) for _ in range(2)]
+            for _ in range(2)]
+    sids = [_post_stream(server, {"image": fr[0].tolist()})["session"]
+            for fr in seqs]
+    nonfinite0 = server._robustness["nonfinite"].value
+    degraded0 = server.streams.metrics["degraded"].value
+    server.faults.force("nan", [1])
+    out = _coalesced_advance(server, sids, [fr[1] for fr in seqs])
+    assert [r["meta"]["batch_real"] for r in out] == [2, 2]  # coalesced
+    # exactly one row was poisoned -> healed cold; the other stayed warm
+    assert sorted(r["meta"]["warm"] for r in out) == [False, True]
+    for i, r in enumerate(out):
+        assert np.isfinite(np.asarray(r["flow"])).all()
+        pw = _post_flow(server, seqs[i][0], seqs[i][1])
+        np.testing.assert_allclose(np.asarray(r["flow"], np.float32),
+                                   np.asarray(pw["flow"], np.float32),
+                                   rtol=1e-4, atol=1e-2)
+    assert server._robustness["nonfinite"].value == nonfinite0 + 1
+    assert server.streams.metrics["degraded"].value == degraded0 + 1
+    assert server.engine.compile_misses == 0
+    for sid in sids:
+        _post_stream(server, {"op": "close", "session": sid})
+
+
+def test_group_engine_fault_degrades_every_row_cold(chaos_group_server):
+    """Chaos ``engine_error`` on the BATCHED call: the whole group
+    degrades to per-row cold restarts in the same advance — every
+    client sees 200 + warm:false and the pairwise-correct flow (the
+    stream path's form of poisoned-batch isolation)."""
+    server = chaos_group_server
+    rng = np.random.RandomState(61)
+    seqs = [[rng.rand(32, 48, 3).astype(np.float32) for _ in range(2)]
+            for _ in range(2)]
+    sids = [_post_stream(server, {"image": fr[0].tolist()})["session"]
+            for fr in seqs]
+    degraded0 = server.streams.metrics["degraded"].value
+    server.faults.force("engine_error", [1])
+    out = _coalesced_advance(server, sids, [fr[1] for fr in seqs])
+    assert [r["meta"]["warm"] for r in out] == [False, False]
+    for i, r in enumerate(out):
+        pw = _post_flow(server, seqs[i][0], seqs[i][1])
+        np.testing.assert_allclose(np.asarray(r["flow"], np.float32),
+                                   np.asarray(pw["flow"], np.float32),
+                                   rtol=1e-4, atol=1e-2)
+    assert server.streams.metrics["degraded"].value == degraded0 + 2
+    assert server.engine.compile_misses == 0
+    for sid in sids:
+        _post_stream(server, {"op": "close", "session": sid})
+
+
+def test_group_session_poison_isolated_by_sentinel(chaos_group_server):
+    """Chaos ``session`` arm under the group path: ONE session's slot
+    row is NaN-poisoned device-side; the batched gather carries the
+    poison into exactly that row's output, the sentinel catches it, and
+    only that session degrades — its batch-mate stays warm."""
+    server = chaos_group_server
+    rng = np.random.RandomState(62)
+    seqs = [[rng.rand(32, 48, 3).astype(np.float32) for _ in range(2)]
+            for _ in range(2)]
+    sids = [_post_stream(server, {"image": fr[0].tolist()})["session"]
+            for fr in seqs]
+    nonfinite0 = server._robustness["nonfinite"].value
+    # corrupt_session rolls once per group row: fire on the FIRST row
+    # only (forced outcomes drain in call order)
+    server.faults.force("session", [1, 0])
+    out = _coalesced_advance(server, sids, [fr[1] for fr in seqs])
+    assert sorted(r["meta"]["warm"] for r in out) == [False, True]
+    for i, r in enumerate(out):
+        assert np.isfinite(np.asarray(r["flow"])).all()
+        pw = _post_flow(server, seqs[i][0], seqs[i][1])
+        np.testing.assert_allclose(np.asarray(r["flow"], np.float32),
+                                   np.asarray(pw["flow"], np.float32),
+                                   rtol=1e-4, atol=1e-2)
+    assert server._robustness["nonfinite"].value == nonfinite0 + 1
+    assert server.engine.compile_misses == 0
+    for sid in sids:
+        _post_stream(server, {"op": "close", "session": sid})
+
+
 def test_session_store_demote_all_skips_inflight():
     store = SessionStore(max_sessions=4, ttl_s=60.0)
     a, b = store.open(BUCKET), store.open(BUCKET)
-    store.attach_features(a, "f", "c", None)
-    store.attach_features(b, "f", "c", None)
+    store.promote(a)
+    store.promote(b)
     with b.lock:
         assert store.demote_all() == 1
+    # the skipped in-flight session keeps its slot; a's went back
     assert not a.has_features and b.has_features
+    assert store.pool.in_use(BUCKET) == 1
     assert store.resident_count() == 2              # records kept

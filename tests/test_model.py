@@ -451,3 +451,115 @@ def test_stream_step_fn_counted_under_converge():
     assert iters_used.shape == (1,)
     assert int(iters_used[0]) == 2               # exited at min_iters
     assert np.isfinite(np.asarray(flow)).all()
+
+
+# ------------------------------- continuous-batched stream step (slots) --
+
+
+def _slot_fixture(config, n=3, cap=4, H=32, W=48, seed=11):
+    """N sessions' prev/cur frames + slot-pool buffers holding the prev
+    maps in rows 0..n-1 (row `cap` is the scratch slot)."""
+    from raft_tpu.models import encode_frame
+
+    rng = np.random.RandomState(seed)
+    params = init_raft(jax.random.PRNGKey(seed), config)
+    h, w = H // 8, W // 8
+    prev = [rng.rand(1, H, W, 3).astype(np.float32) for _ in range(n)]
+    cur = [rng.rand(1, H, W, 3).astype(np.float32) for _ in range(n)]
+    maps = [encode_frame(params, jnp.asarray(p), config) for p in prev]
+    fbuf = jnp.zeros((cap + 1, h, w, maps[0][0].shape[-1]),
+                     maps[0][0].dtype)
+    cbuf = jnp.zeros((cap + 1, h, w, maps[0][1].shape[-1]),
+                     maps[0][1].dtype)
+    flbuf = jnp.zeros((cap + 1, h, w, 2), jnp.float32)
+    for i, (fm, cn) in enumerate(maps):
+        fbuf = fbuf.at[i].set(fm[0])
+        cbuf = cbuf.at[i].set(cn[0])
+    return params, prev, cur, maps, (fbuf, cbuf, flbuf)
+
+
+def test_stream_batch_step_equals_solo_rows():
+    """The continuous-batched stream step (ISSUE 15): N sessions advanced
+    in one batch vs each advanced alone.
+
+    Pinned exactly (bit-for-bit, converge:0): (a) at the SAME batch
+    width, a row's output is independent of its batch-mates — real
+    neighbors vs scratch-slot padding rows produce identical bits (the
+    per-row independence + active-mask correctness the batcher relies
+    on); (b) the width-1 batched step (gather from slots) equals the
+    solo make_stream_step_fn (maps as arguments) bit-for-bit.  Across
+    DIFFERENT widths XLA reassociates conv reductions (same caveat as
+    test_converge_per_sample_freeze_mixed_batch), so batch-N vs batch-1
+    is pinned scale-relative instead."""
+    from raft_tpu.models import make_stream_batch_step_fn, make_stream_step_fn
+
+    config = RAFTConfig.small_model(iters=3, iters_policy="converge:0")
+    n, cap = 3, 4
+    params, prev, cur, maps, bufs = _slot_fixture(config, n=n, cap=cap)
+    fbuf, cbuf, flbuf = bufs
+    step = jax.jit(make_stream_batch_step_fn(config))
+
+    # one batched call, padded 3 -> 4 with an inactive scratch row
+    images = jnp.asarray(np.concatenate(cur + [cur[-1]]))
+    slots = jnp.asarray([0, 1, 2, cap], jnp.int32)
+    active = jnp.asarray([True, True, True, False])
+    flow_n, flr_n, fm_n, cn_n, it_n = step(params, images, fbuf, cbuf,
+                                           flbuf, slots, active)
+    assert np.asarray(it_n).tolist() == [3, 3, 3, 0]   # padding: 0 iters
+
+    # (a) same-width independence: 1 real row + 3 padding rows — row 0's
+    # bits must not change with its batch-mates
+    flow_p, _, _, _, it_p = step(
+        params, jnp.asarray(np.concatenate([cur[0]] * 4)), fbuf, cbuf,
+        flbuf, jnp.asarray([0, cap, cap, cap], jnp.int32),
+        jnp.asarray([True, False, False, False]))
+    assert np.array_equal(np.asarray(flow_p[0]), np.asarray(flow_n[0]))
+    assert np.asarray(it_p).tolist() == [3, 0, 0, 0]
+
+    solo = jax.jit(make_stream_step_fn(config))
+    h, w = 4, 6
+    for i in range(n):
+        # (b) width-1 batched == solo step, bit-for-bit (same width, the
+        # gather feeds identical values)
+        f1, fl1, fm1, cn1, it1 = step(params, jnp.asarray(cur[i]),
+                                      fbuf, cbuf, flbuf,
+                                      jnp.asarray([i], jnp.int32),
+                                      jnp.asarray([True]))
+        f_s, fl_s, fm_s, cn_s, _ = solo(params, jnp.asarray(cur[i]),
+                                        maps[i][0], maps[i][1],
+                                        jnp.zeros((1, h, w, 2),
+                                                  jnp.float32))
+        assert np.array_equal(np.asarray(f1), np.asarray(f_s)), i
+        assert np.array_equal(np.asarray(fl1), np.asarray(fl_s)), i
+        # batch-N vs batch-1: scale-relative (cross-width conv
+        # reassociation), per row
+        a = np.asarray(flow_n[i])
+        scale = max(np.abs(a).mean(), 1e-3)
+        assert np.abs(a - np.asarray(f1[0])).max() / scale < 1e-2, i
+        assert int(it1[0]) == int(it_n[i]) == 3
+        # the returned current-frame map rows equal the solo step's
+        # (they become the session cache)
+        np.testing.assert_allclose(np.asarray(fm_n[i]), np.asarray(fm1[0]),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_stream_batch_step_padding_never_extends_while_loop():
+    """Under a converge policy, inactive rows start CONVERGED: they
+    report iters_used == 0 and a batch whose real rows all exit at
+    min_iters exits the whole while_loop there — padding can never cost
+    iterations (the padding-exclusion contract of the serving
+    metrics)."""
+    from raft_tpu.models import make_stream_batch_step_fn
+
+    config = RAFTConfig.small_model(iters=5, iters_policy="converge:1e9:2")
+    params, prev, cur, maps, bufs = _slot_fixture(config, n=2, cap=4,
+                                                  seed=13)
+    fbuf, cbuf, flbuf = bufs
+    step = jax.jit(make_stream_batch_step_fn(config))
+    images = jnp.asarray(np.concatenate(cur + [cur[-1]] * 2))
+    out = step(params, images, fbuf, cbuf, flbuf,
+               jnp.asarray([0, 1, 4, 4], jnp.int32),
+               jnp.asarray([True, True, False, False]))
+    flow, _, _, _, iters_used = out
+    assert np.asarray(iters_used).tolist() == [2, 2, 0, 0]
+    assert np.isfinite(np.asarray(flow[:2])).all()

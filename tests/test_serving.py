@@ -598,16 +598,21 @@ def test_session_store_lru_demotes_features():
 
     store = SessionStore(max_sessions=2, ttl_s=60.0)
     a, b, c = (store.open((32, 48)) for _ in range(3))
-    for s in (a, b, c):
-        store.attach_features(s, "fmap", "cnet", None)
-    # capacity 2: attaching c demoted the LRU holder (a) — record kept
+    slots = [store.promote(s) for s in (a, b, c)]
+    assert None not in slots
+    # capacity 2: promoting c demoted the LRU holder (a) — record kept,
+    # a's slot freed back to the pool (c reuses it)
     assert store.active_count() == 2
+    assert store.pool.in_use((32, 48)) == 2
     assert store.resident_count() == 3
     assert not a.has_features and a.bucket == (32, 48)
     assert b.has_features and c.has_features
     # re-promoting a demotes the now-LRU b
-    store.attach_features(a, "fmap2", "cnet2", None)
+    store.promote(a)
     assert a.has_features and not b.has_features and c.has_features
+    # a session that already holds a slot keeps it (in-place commit path)
+    assert store.promote(c) == c.slot
+    assert store.pool.in_use((32, 48)) == 2
 
 
 def test_session_store_skips_inflight_on_demote_and_sweep():
@@ -615,15 +620,18 @@ def test_session_store_skips_inflight_on_demote_and_sweep():
 
     store = SessionStore(max_sessions=1, ttl_s=60.0)
     a = store.open((32, 48))
-    store.attach_features(a, "f", "c", None)
+    store.promote(a)
     with a.lock:                         # a is mid-advance
         b = store.open((32, 48))
-        store.attach_features(b, "f", "c", None)
-        assert a.has_features            # locked: not a demotion target
+        # a is locked (not demotable) and holds the only slot: b stays
+        # cold rather than stealing an in-flight session's slot
+        assert store.promote(b) is None
+        assert a.has_features and not b.has_features
         assert store.sweep(now=time.monotonic() + 999) >= 1   # b reaped
         assert store.get(a.id) is a      # locked: not reaped either
     store.sweep(now=time.monotonic() + 999)
     assert store.get(a.id) is None       # unlocked: TTL reaps it
+    assert store.pool.in_use((32, 48)) == 0   # ...and frees its slot
 
 
 def test_session_store_ttl_and_record_cap():
@@ -639,6 +647,105 @@ def test_session_store_ttl_and_record_cap():
     store.sweep()
     assert store.resident_count() == 0   # TTL reaped the rest
     assert store.close(ids[-1]) is None  # already gone
+
+
+def test_sweep_frees_device_slot_back_to_pool():
+    """TTL reaping must return the reaped session's device slot to the
+    pool (not just drop the Python record), or a long-lived server
+    strands slot capacity behind dead sessions."""
+    from raft_tpu.serving import SessionStore
+
+    store = SessionStore(max_sessions=2, ttl_s=0.001)
+    a, b = store.open((32, 48)), store.open((32, 48))
+    store.promote(a)
+    store.promote(b)
+    assert store.pool.in_use((32, 48)) == 2
+    time.sleep(0.005)
+    assert store.sweep() == 2
+    assert store.pool.in_use((32, 48)) == 0
+    # the freed slots are allocatable again
+    c, d = store.open((32, 48)), store.open((32, 48))
+    assert store.promote(c) is not None and store.promote(d) is not None
+
+
+def test_slot_pool_concurrent_open_close_evict_no_leaks():
+    """Slot alloc/free under concurrent open/promote/close/sweep from
+    many threads: accounting must balance exactly — every allocated slot
+    is either held by a live promoted session or back on the free list,
+    and in_use never exceeds capacity."""
+    from raft_tpu.serving import SessionStore
+
+    store = SessionStore(max_sessions=4, ttl_s=60.0)
+    bucket = (32, 48)
+    errors = []
+
+    def churn(seed):
+        rng = np.random.RandomState(seed)
+        try:
+            for _ in range(60):
+                s = store.open(bucket)
+                with s.lock:
+                    store.promote(s)
+                assert store.pool.in_use(bucket) <= store.pool.capacity
+                if rng.rand() < 0.5:
+                    store.close(s.id)
+                if rng.rand() < 0.2:
+                    store.sweep(now=time.monotonic() - 1)  # reaps nothing
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=churn, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    # drain everything: no slot may stay stranded
+    for sid in list(store._sessions):
+        store.close(sid)
+    assert store.resident_count() == 0
+    assert store.pool.in_use(bucket) == 0
+
+
+def test_demote_bucket_overrides_inflight_skip():
+    """The failed-commit recovery hook: after a bucket's buffers are
+    rebuilt zeroed, EVERY session of that bucket must lose its slot —
+    in-flight ones included (a kept slot would gather the zeros) —
+    while other buckets' sessions are untouched."""
+    from raft_tpu.serving import SessionStore
+
+    store = SessionStore(max_sessions=4, ttl_s=60.0)
+    a, b = store.open((32, 48)), store.open((32, 48))
+    c = store.open((64, 96))
+    for s in (a, b, c):
+        store.promote(s)
+    with a.lock:                         # a is mid-advance: still demoted
+        assert store.demote_bucket((32, 48)) == 2
+    assert not a.has_features and not b.has_features
+    assert c.has_features                # other bucket untouched
+    assert store.pool.in_use((32, 48)) == 0
+    assert store.pool.in_use((64, 96)) == 1
+    # idempotent per session: demote after the bucket sweep is a no-op
+    store.demote(a, "degraded")
+    assert store.pool.in_use((32, 48)) == 0
+
+
+def test_close_during_inflight_advance_defers_slot_free():
+    """close() racing an in-flight advance must NOT free the slot while
+    the batcher may still scatter into it — the handler's
+    reclaim_if_closed epilogue frees it after the session lock drops."""
+    from raft_tpu.serving import SessionStore
+
+    store = SessionStore(max_sessions=2, ttl_s=60.0)
+    s = store.open((32, 48))
+    store.promote(s)
+    with s.lock:                         # a frame is in flight
+        store.close(s.id)
+        assert s.slot is not None        # deferred: batcher-safe
+        assert store.pool.in_use((32, 48)) == 1
+    store.reclaim_if_closed(s)           # the handler epilogue
+    assert s.slot is None
+    assert store.pool.in_use((32, 48)) == 0
 
 
 # --------------------------------------------- streaming: live server -----
@@ -688,9 +795,13 @@ def test_stream_warmup_shares_cache_namespace(stream_server):
     """Pair, encode, and stream executables are all warmed into ONE engine
     cache, keyed by kind + policy; nothing compiles at serve time."""
     server, _, _ = stream_server
-    assert server.engine.keys() == [("encode", 32, 48, 1, "fixed"),
-                                    ("pair", 32, 48, 1, "fixed"),
-                                    ("stream", 32, 48, 1, "fixed")]
+    assert server.engine.keys() == [
+        ("encode", 32, 48, 1, "fixed"),
+        ("pair", 32, 48, 1, "fixed"),
+        ("sbatch", 32, 48, 1, "fixed"),     # continuous-batched advance
+        ("scommit", 32, 48, 1, "fixed"),    # slot-pool commit scatter
+        ("stream", 32, 48, 1, "fixed"),     # cold-restart solo step
+        ("szero", 32, 48, 1, "fixed")]      # pool buffer builder
     assert server.engine.compile_misses == 0
 
 
@@ -847,6 +958,93 @@ def test_stream_npz_round_trip(stream_server):
     _post_stream(server, {"op": "close", "session": sid})
 
 
+def test_stream_continuous_batching_coalesces_sessions():
+    """The ISSUE 15 tentpole, end to end over HTTP: concurrent advances
+    from DIFFERENT sessions coalesce into ONE batched stream device call
+    (slot-pool gather -> batched step -> masked commit), padded to a
+    declared batch step, with a demoted session's row degrading to the
+    cold path INSIDE the same group, per-row iters accounted (padding
+    excluded), and zero compile misses at the batched widths."""
+    from raft_tpu.config import RAFTConfig, init_rng
+    from raft_tpu.models import init_raft
+
+    config = RAFTConfig.small_model(iters=3)
+    params = init_raft(init_rng(), config)
+    # max_wait 250ms: wide enough that the three barrier-released
+    # advances always coalesce; max_sessions=2 of 3 sessions forces one
+    # demoted (cold) row into the coalesced group
+    sconfig = ServeConfig(buckets=((32, 48),), max_batch=4,
+                          batch_steps=(1, 2, 4), max_wait_ms=250.0,
+                          queue_depth=16, default_deadline_ms=30_000.0,
+                          port=0, max_sessions=2, session_ttl_s=600.0,
+                          iters_policy="converge:1e9:2")
+    server = FlowServer(config, params, sconfig)
+    server.start()
+    try:
+        eng = server.engine
+        seqs = [_frames(40 + i, 2) for i in range(3)]
+        sids = [_post_stream(server, {"image": fr[0].tolist()})["session"]
+                for fr in seqs]
+        # 3 opens > max_sessions=2: the first session's slot was demoted
+        assert server.streams.store.pool.in_use((32, 48)) == 2
+        iters0 = server.metrics["iters_used"].count
+        str0, enc0 = eng.stream_calls, eng.encode_calls
+        barrier = threading.Barrier(3)
+        out, errs = [None] * 3, []
+
+        def advance(i):
+            try:
+                barrier.wait(timeout=10)
+                out[i] = _post_stream(server, {"session": sids[i],
+                                               "image": seqs[i][1].tolist()})
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=advance, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        # one coalesced group of 3, padded to the declared step 4
+        assert [r["meta"]["batch_real"] for r in out] == [3, 3, 3]
+        assert [r["meta"]["batch_padded"] for r in out] == [4, 4, 4]
+        # the demoted session (LRU: the first opened) healed cold inside
+        # the group; its slot-holding batch-mates stayed warm
+        assert [r["meta"]["warm"] for r in out] == [False, True, True]
+        # every row's flow equals the pairwise answer on its own frames
+        # (first advances seed zero flow, exactly like /v1/flow)
+        for i, r in enumerate(out):
+            pw = _post_json(server, seqs[i][0], seqs[i][1])
+            np.testing.assert_allclose(
+                np.asarray(r["flow"], np.float32),
+                np.asarray(pw["flow"], np.float32), rtol=1e-4, atol=1e-2)
+        # per-row iters recorded for the 3 REAL rows only (the padding
+        # row is excluded), each exiting at min_iters
+        assert [r["meta"]["iters_used"] for r in out] == [2, 2, 2]
+        assert server.metrics["iters_used"].count - iters0 >= 3
+        # fnet accounting: 1 stream row per warm advance + 1 for the cold
+        # heal's re-run; the cold heal also re-encoded the prev frame
+        assert eng.stream_calls == str0 + 3
+        assert eng.encode_calls == enc0 + 1
+        # the stream step families saw the real width
+        with urllib.request.urlopen(server.url + "/metrics") as r:
+            text = r.read().decode()
+        prom = dict(
+            ln.rsplit(" ", 1) for ln in text.splitlines()
+            if ln and not ln.startswith("#"))
+        assert float(prom["raft_stream_step_batch_sum"]) >= 3.0
+        assert 'raft_stream_slots_in_use{bucket="32x48"} 2' in text
+        assert 'raft_stream_slot_capacity{bucket="32x48"} 2' in text
+        assert eng.compile_misses == 0       # batched widths all warmed
+        for sid in sids:
+            _post_stream(server, {"op": "close", "session": sid})
+        assert server.streams.store.pool.in_use((32, 48)) == 0
+    finally:
+        server.stop()
+
+
 def test_stream_converge_policy_end_to_end():
     """Streaming under --iters-policy: policy-keyed pair/encode/stream
     executables, per-advance iters_used in meta and the raft_iters_used
@@ -866,7 +1064,10 @@ def test_stream_converge_policy_end_to_end():
         assert server.engine.keys() == [
             ("encode", 32, 48, 1, "converge:1e9:2"),
             ("pair", 32, 48, 1, "converge:1e9:2"),
-            ("stream", 32, 48, 1, "converge:1e9:2")]
+            ("sbatch", 32, 48, 1, "converge:1e9:2"),
+            ("scommit", 32, 48, 1, "converge:1e9:2"),
+            ("stream", 32, 48, 1, "converge:1e9:2"),
+            ("szero", 32, 48, 1, "converge:1e9:2")]
         frames = _frames(35, 3)
         sid = _post_stream(server, {"image": frames[0].tolist()})["session"]
         for t in (1, 2):

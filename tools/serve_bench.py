@@ -42,12 +42,22 @@ healthz recovers within one breaker window, and nothing recompiled.
 synthetic N-frame sequences (``--frames``) each run twice over the SAME
 frames — pairwise through ``/v1/flow`` (the cold baseline: two encoder
 passes + cold iterations per pair) and sessionfully through
-``/v1/stream`` (cached features + warm-started recurrence).  The record
-reports pairs/sec for both arms, the encoder-pass saving (from the
-``raft_stream_fnet_cache_*`` counters), and iters p50/p95 cold vs
-streamed (phase-diffed ``raft_iters_used`` histograms).  With ``--smoke``
-it asserts zero recompiles under the watchdog and non-zero fnet cache
-hits — the CI streaming gate.
+``/v1/stream`` (cached features + warm-started recurrence, advances
+CONTINUOUSLY BATCHED across sessions via the device-resident slot
+pool).  Closed-loop sessions advance in frame LOCKSTEP (a barrier —
+real video produces a frame per wall-clock tick, and it gives the
+batcher's coalescing window a deterministic shot every step);
+``--mode open --rate R`` composes open-loop session arrivals at R
+sessions/s instead.  The record reports pairs/sec AND device-batch
+occupancy for both arms side by side (batched stream steps fold into
+the shared ``raft_serving_batch_*`` histograms), the per-step
+coalescing width (``raft_stream_step_batch``), slot-pool usage, the
+encoder-pass saving (from the ``raft_stream_fnet_cache_*`` counters),
+and iters p50/p95 cold vs streamed (phase-diffed ``raft_iters_used``
+histograms).  With ``--smoke`` it asserts zero recompiles under the
+watchdog, non-zero fnet cache hits, mean stream-step width > 1 across
+lockstep sessions, and zero lock-order violations (the validator is
+self-armed) — the CI streaming gate.
 """
 
 from __future__ import annotations
@@ -203,9 +213,11 @@ class StreamClient(Client):
             self.results.append((status, time.monotonic() - t0))
         return status, payload
 
-    def run_sequence(self, frames):
+    def run_sequence(self, frames, pace=None):
         """open -> advance x (n-1) -> close; only advances land in the
-        shared results list (they are the pairs)."""
+        shared results list (they are the pairs).  ``pace`` (a _Pace
+        barrier) releases every session's frame t together — lockstep
+        video."""
         saved = self.results
         self.results = []                # opens/closes: not pairs
         st, payload = self.post("/v1/stream", _npz(image=frames[0]))
@@ -213,10 +225,14 @@ class StreamClient(Client):
         if st != 200:
             with self.lock:
                 self.results.append((st, 0.0))
+            if pace is not None:
+                pace.abort()             # don't strand the other sessions
             return
         with np.load(io.BytesIO(payload)) as z:
             sid = str(z["session"])
         for f in frames[1:]:
+            if pace is not None:
+                pace.wait()
             self.post("/v1/stream", _npz(op=np.asarray("advance"),
                                          session=np.asarray(sid), image=f))
         saved = self.results
@@ -225,25 +241,65 @@ class StreamClient(Client):
                                      session=np.asarray(sid)))
         self.results = saved
 
-    def run_pairwise(self, frames):
+    def run_pairwise(self, frames, pace=None):
         for a, b in zip(frames[:-1], frames[1:]):
+            if pace is not None:
+                pace.wait()
             self.post("/v1/flow", _npz(image1=a, image2=b))
 
 
-def run_video(host, port, sequences, stream):
-    """Drive every sequence concurrently (one worker per session);
-    returns (results, elapsed)."""
-    results, lock = [], threading.Lock()
+class _Pace:
+    """Frame-lockstep barrier for the closed-loop video arms: real video
+    traffic is synchronized by wall clock (every stream produces a frame
+    per tick), and the barrier reproduces that — all N sessions submit
+    frame t inside one coalescing window, so the batcher's continuous
+    stream batching gets a deterministic shot at every step.  A failed
+    session aborts the barrier; survivors free-run instead of hanging."""
 
-    def worker(frames):
+    def __init__(self, n: int):
+        self._barrier = threading.Barrier(n) if n > 1 else None
+
+    def wait(self) -> None:
+        if self._barrier is None:
+            return
+        try:
+            self._barrier.wait(timeout=30.0)
+        except threading.BrokenBarrierError:
+            pass
+
+    def abort(self) -> None:
+        if self._barrier is not None:
+            self._barrier.abort()
+
+
+def run_video(host, port, sequences, stream, lockstep=True, rate=None,
+              seed=0):
+    """Drive every sequence concurrently (one worker per session);
+    returns (results, elapsed).  ``lockstep`` paces frames with a
+    barrier (closed-loop arm); ``rate`` composes OPEN-LOOP session
+    arrivals instead — session starts are Poisson-spaced at ``rate``
+    sessions/s and each session then free-runs, so coalescing depends
+    on genuine overlap (the tail/occupancy probe under realistic
+    arrivals)."""
+    results, lock = [], threading.Lock()
+    pace = _Pace(len(sequences)) if (lockstep and rate is None) else None
+    delays = None
+    if rate is not None:
+        rng = np.random.RandomState(seed)
+        gaps = rng.exponential(1.0 / rate, size=len(sequences))
+        delays = np.cumsum(gaps) - gaps[0]     # first session at t=0
+
+    def worker(i, frames):
+        if delays is not None and delays[i] > 0:
+            time.sleep(float(delays[i]))
         c = StreamClient(host, port, b"", results, lock)
         if stream:
-            c.run_sequence(frames)
+            c.run_sequence(frames, pace=pace)
         else:
-            c.run_pairwise(frames)
+            c.run_pairwise(frames, pace=pace)
 
-    threads = [threading.Thread(target=worker, args=(fr,))
-               for fr in sequences]
+    threads = [threading.Thread(target=worker, args=(i, fr))
+               for i, fr in enumerate(sequences)]
     t0 = time.monotonic()
     for t in threads:
         t.start()
@@ -538,13 +594,18 @@ def run_video_bench(args, host, port, server, config) -> int:
                                 shift=args.shift)
             for i in range(sessions)]
     pairs = sessions * (args.frames - 1)
+    rate = args.rate if args.mode == "open" else None
     print(f"[bench] video: {sessions} session(s) x {args.frames} frames "
-          f"({pairs} pairs/arm, {args.shift}px/frame) at {h}x{w}")
+          f"({pairs} pairs/arm, {args.shift}px/frame) at {h}x{w}  "
+          + (f"open-loop arrivals at {rate:g} sessions/s" if rate
+             else "lockstep frames"))
 
     prom0 = scrape(host, port)
-    cold_res, cold_s = run_video(host, port, seqs, stream=False)
+    cold_res, cold_s = run_video(host, port, seqs, stream=False,
+                                 rate=rate)
     prom_cold = scrape(host, port)
-    stream_res, stream_s = run_video(host, port, seqs, stream=True)
+    stream_res, stream_s = run_video(host, port, seqs, stream=True,
+                                     rate=rate)
     prom_stream = scrape(host, port)
     if server is not None:
         server.stop()
@@ -559,8 +620,19 @@ def run_video_bench(args, host, port, server, config) -> int:
 
     def phase(results, elapsed, d):
         ok = sum(1 for st, _ in results if st == 200)
+        # the SHARED device-batch histograms, phase-diffed: batched
+        # stream steps now fold into raft_serving_batch_size/occupancy,
+        # so stream occupancy reads directly next to pairwise occupancy
+        occ_cnt = d.get("raft_serving_batch_occupancy_count", 0)
+        bs_cnt = d.get("raft_serving_batch_size_count", 0)
         return {"pairs_per_sec": round(ok / elapsed, 3) if elapsed else 0.0,
                 "elapsed_s": round(elapsed, 3), "statuses": statuses(results),
+                "batch_size_mean": round(
+                    d.get("raft_serving_batch_size_sum", 0.0) / bs_cnt, 3)
+                if bs_cnt else None,
+                "batch_occupancy_mean": round(
+                    d.get("raft_serving_batch_occupancy_sum", 0.0)
+                    / occ_cnt, 3) if occ_cnt else None,
                 "iters_used": _iters_summary(d)}
 
     advances = stream_d.get("raft_stream_frames_total", 0)
@@ -607,9 +679,12 @@ def run_video_bench(args, host, port, server, config) -> int:
             100.0 * (1.0 - fnet_passes / (2.0 * advances)), 1)
         if advances else None,
         "device_steps": step_stats,
+        "slots": {k.split('"')[1]: int(v) for k, v in prom_stream.items()
+                  if k.startswith("raft_stream_slots_in_use{")} or None,
     })
     rec = {
         "bench": "serving", "mode": "video",
+        "arrivals": (f"open:{args.rate:g}/s" if rate else "lockstep"),
         "sessions": sessions, "frames_per_session": args.frames,
         "pairs_per_arm": pairs, "image_hw": [h, w],
         "shift_px_per_frame": args.shift,
@@ -640,6 +715,15 @@ def run_video_bench(args, host, port, server, config) -> int:
         if not args.url and step_stats is None:
             problems.append("raft_stream_step_seconds never observed — "
                             "the stream-path step histograms are dead")
+        if (not args.url and sessions > 1 and rate is None
+                and (step_stats or {}).get("batch_mean", 0) <= 1.0):
+            # the continuous-batching gate: lockstep sessions MUST
+            # coalesce — a mean stream-step width of 1 means every
+            # advance still serialized through its own device call
+            problems.append(
+                f"stream steps never coalesced across {sessions} "
+                f"lockstep sessions (mean step batch "
+                f"{(step_stats or {}).get('batch_mean')})")
         if rec["compile_misses_after_warmup"] != 0:
             problems.append(f"{rec['compile_misses_after_warmup']} "
                             f"compile(s) after warmup")
@@ -651,6 +735,17 @@ def run_video_bench(args, host, port, server, config) -> int:
             elif recompiles != 0:
                 problems.append(f"{int(recompiles)} XLA recompile(s) after "
                                 f"warmup while streaming")
+            # the video smoke self-arms the runtime lock-order validator
+            # (the slot pool added a lock to the serving hierarchy):
+            # coalesced streaming must stay inversion-free
+            lock_order = prom_stream.get("raft_lock_order_violations_total")
+            if lock_order is None:
+                problems.append("lock-order validator families missing "
+                                "from /metrics (RAFT_TPU_LOCK_WATCH never "
+                                "armed for the video smoke)")
+            elif lock_order != 0:
+                problems.append(f"{int(lock_order)} lock-order "
+                                f"violation(s) under coalesced streaming")
         if problems:
             print("[bench] SMOKE FAIL: " + "; ".join(problems))
             return 1
@@ -699,8 +794,12 @@ def main() -> int:
                    help="streaming-workload probe: per-session frame "
                         "sequences through /v1/flow (cold pairwise "
                         "baseline) then /v1/stream (cached features + "
-                        "warm start) — reports pairs/sec, encoder-pass "
-                        "saving, and iters cold vs streamed")
+                        "warm start, advances COALESCED across sessions) "
+                        "— reports pairs/sec, encoder-pass saving, iters "
+                        "cold vs streamed, and stream-vs-pairwise batch "
+                        "occupancy.  Frames run in lockstep by default; "
+                        "'--mode open --rate R' composes open-loop "
+                        "session arrivals at R sessions/s instead")
     p.add_argument("--frames", type=int, default=8,
                    help="video mode: frames per session (pairs = frames-1)")
     p.add_argument("--sessions", type=int, default=None,
@@ -743,6 +842,10 @@ def main() -> int:
         if args.video:
             args.frames = min(args.frames, 4)
             args.sessions = args.sessions or 2
+            # coalesced streaming exercises the slot-pool lock: every
+            # video smoke doubles as a race hunt (armed BEFORE the
+            # server constructs its locks)
+            os.environ.setdefault("RAFT_TPU_LOCK_WATCH", "1")
         args.cpu = True
         if args.iters_policy is None and not args.url:
             # the smoke exercises the adaptive path by default: counted
