@@ -1,6 +1,6 @@
 """raftlint: JAX + concurrency static analysis and contracts for raft-tpu.
 
-Three halves:
+Four halves:
 
 * :mod:`raft_tpu.lint.engine` + :mod:`raft_tpu.lint.rules` — an AST
   analysis suite (no jax import, scanned code is never executed) catching
@@ -11,7 +11,13 @@ Three halves:
   library prints (R10) — plus the lock-discipline family C1-C6 for the
   threaded serving plane (unguarded shared writes, blocking under a lock,
   lock-order cycles/inversions, wait predicates, check-then-act inits,
-  unsynchronized counters).
+  unsynchronized counters) and the serving budget family B1-B4
+  (request-derived shapes into jit, unwarmed engine-cache kinds, hot-path
+  device allocation, hardcoded VMEM/HBM constants).
+* :mod:`raft_tpu.lint.budget` — the static capacity analyzer behind
+  ``raftlint --budget``: exact warmup-grid enumeration (consumed by the
+  engine's warmup itself), ``jax.eval_shape`` HBM pricing, and the Pallas
+  block plans / VMEM envelopes the kernels import.
 * :mod:`raft_tpu.lint.concurrency` — the ``guarded_by`` annotation layer
   and the shared class/lock analysis the C rules, the SERVING.md
   threading-model generated check, and the runtime lock-order validator
@@ -19,7 +25,8 @@ Three halves:
 * :mod:`raft_tpu.lint.contracts` — ``@contract`` shape/dtype specs on the
   hot-path signatures, checked statically by R9 and (opt-in) at trace time.
 
-CLI: ``python tools/raftlint.py [paths] [--strict]``.  Docs: LINT.md.
+CLI: ``python tools/raftlint.py [paths] [--strict]`` and
+``python tools/raftlint.py --budget [--strict]``.  Docs: LINT.md.
 """
 
 from .concurrency import SERVING_LOCK_HIERARCHY, guarded_by  # noqa: F401

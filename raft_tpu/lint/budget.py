@@ -1,0 +1,567 @@
+"""Static compile-surface + device-memory budget analyzer (LINT.md B family).
+
+Given a (RAFTConfig, ServeConfig) pair — no device, no compile — this module
+answers the three questions nothing else in the repo could before a replica
+boots:
+
+* **What will the engine compile?**  :func:`enumerate_warmup_grid` produces
+  the exact ``(kind, h, w, b, policy)`` key list ``serving/engine.py``
+  warmup builds.  It is not a parallel reimplementation that could drift:
+  the engine's own ``warmup()`` consumes THIS function, and the parity
+  test pins analyzer enumeration == live warm-engine key set exactly.
+* **Does the config fit HBM, and how many sessions per chip?**
+  :func:`analyze` computes per-executable and aggregate footprints via
+  ``jax.eval_shape`` abstract evaluation (params, per-bucket SlotPool
+  buffers, peak live call buffers per kind — donation-aware: the commit
+  scatter's donated pool buffers are not double-counted off-CPU) and
+  solves max-sessions headroom against the per-device-kind budget.
+* **Do the Pallas kernels fit VMEM?**  The block-planning arithmetic of
+  ``ops/corr_pallas.py`` and ``ops/gru_pallas.py`` lives HERE
+  (:func:`corr_level_plan` / :func:`gru_row_plan`) and the kernels import
+  it, so the VMEM envelope the analyzer checks is the same math the
+  kernels execute — a hardcoded constant bypassing this module is what
+  lint rule B4 exists to catch.
+
+Layering: module import is pure stdlib (the linter must run without jax);
+jax is imported lazily inside the eval_shape functions only.  The byte
+accounting is an I/O-resident lower bound — XLA's internal temporaries
+(convolution scratch, fusion buffers) ride on top, so headroom numbers are
+optimistic by design and say "cannot fit", never "will surely fit".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# Shared hardware-layout constants (the "budget model" of lint rule B4).
+# ---------------------------------------------------------------------------
+
+#: TPU vector-lane width: the last dim of every VMEM tile pads to this.
+LANE = 128
+#: TPU sublane width: the second-minor dim of a float32 tile pads to this.
+SUBLANE = 8
+#: Usable VMEM per TensorCore (~16 MB on v4/v5e — the Pallas guide's
+#: planning number; the compiler reserves a slice, so treat as a ceiling).
+VMEM_BYTES = 16 * 1024 * 1024
+
+#: Fused-GRU kernel geometry (ops/gru_pallas.py imports these): the pass-1
+#: recompute halo rows, and the separable tap count (1x5 / 5x1 gates).
+GRU_HALO = 4
+GRU_TAPS = 5
+
+#: Per-device-kind capacity budgets the analyzer solves against.  HBM
+#: figures are per-chip; "cpu" is a nominal planning budget so the same
+#: report works on dev machines (host RAM is not really this scarce).
+DEVICE_BUDGETS: Dict[str, Dict[str, int]] = {
+    "tpu-v4":  {"hbm_bytes": 32 * 1024**3, "vmem_bytes": VMEM_BYTES},
+    "tpu-v5e": {"hbm_bytes": 16 * 1024**3, "vmem_bytes": VMEM_BYTES},
+    "cpu":     {"hbm_bytes": 8 * 1024**3,  "vmem_bytes": VMEM_BYTES},
+}
+
+#: Engine-cache key: (kind, bucket H, bucket W, padded batch, iters policy).
+Key = Tuple[str, int, int, int, str]
+
+
+def round_up(x: int, m: int) -> int:
+    """Smallest multiple of ``m`` >= ``x``."""
+    return -(-x // m) * m
+
+
+# ---------------------------------------------------------------------------
+# Compile-surface enumeration (pure; no jax).
+# ---------------------------------------------------------------------------
+
+def resolved_policy(config, sconfig) -> str:
+    """The iteration policy the engine actually serves under: the serving
+    tier's declaration overrides the model config (engine.__init__ applies
+    the same ``dataclasses.replace``)."""
+    if sconfig.iters_policy is not None:
+        return sconfig.iters_policy
+    return config.iters_policy
+
+
+def enumerate_warmup_grid(config, sconfig, stream: Optional[bool] = None,
+                          chaos: Optional[bool] = None) -> List[Key]:
+    """Every engine-cache key ``warmup()`` will build, in insertion order,
+    deduplicated — the engine's compile surface as a value.
+
+    ``stream`` defaults to the server's wiring (``max_sessions > 0``);
+    ``chaos`` (the ``spoison`` drill executable) to whether a chaos spec is
+    armed.  Pass them explicitly to mirror a hand-constructed engine.
+
+    This IS the warmup grid, not a copy of it: ``InferenceEngine.warmup``
+    iterates this list, so analyzer and engine cannot disagree.
+    """
+    if stream is None:
+        stream = sconfig.max_sessions > 0
+    if chaos is None:
+        chaos = sconfig.chaos is not None
+    policy = resolved_policy(config, sconfig)
+    grid = [(h, w, b, "pair") for (h, w) in sconfig.buckets
+            for b in sconfig.batch_steps]
+    if stream:
+        # encode covers session open + cold restart; "stream" is the cold
+        # batch-1 step; the continuous-batched step + its commit scatter
+        # warm at every declared batch width — PLUS width 1 for "scommit"
+        # (commit_row always runs at width 1, and under --serve-dp the
+        # declared steps are multiples of N, never 1); "szero" builds the
+        # pool buffers; "spoison" only exists for chaos drills.
+        grid += [(h, w, 1, kind) for (h, w) in sconfig.buckets
+                 for kind in ("encode", "stream", "szero", "scommit")]
+        grid += [(h, w, b, kind) for (h, w) in sconfig.buckets
+                 for b in sconfig.batch_steps
+                 for kind in ("sbatch", "scommit")]
+        if chaos:
+            grid += [(h, w, 1, "spoison") for (h, w) in sconfig.buckets]
+    keys: List[Key] = []
+    seen = set()
+    for (h, w, b, kind) in grid:
+        key = (kind, h, w, b, policy)
+        if key not in seen:
+            seen.add(key)
+            keys.append(key)
+    return keys
+
+
+# ---------------------------------------------------------------------------
+# Pallas block planning (pure; shared with ops/corr_pallas.py and
+# ops/gru_pallas.py — the kernels import these so envelope math and
+# executed math are one function).
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class CorrLevelPlan:
+    """Block geometry of one ``_lookup_level`` pallas_call.
+
+    ``rows``/``rows_padded`` are in the PACKED row frame when ``pack > 1``
+    (``pack`` real map rows laid side by side per packed row)."""
+
+    t: int              # queries per program ([T, C] f1 block)
+    qp: int             # padded query count (multiple of t)
+    pack: int           # real rows packed side by side per stored row
+    w2p: int            # stored row width, lane-padded (multiple of LANE)
+    h2_blk: int         # stored rows per f2 block
+    rows: int           # stored rows before padding
+    rows_padded: int    # stored rows after padding (multiple of h2_blk)
+    n_pblocks: int      # f2 row-block count (the k grid dimension)
+
+
+def corr_level_plan(q: int, h2: int, w2: int, *, q_blk: int,
+                    p_blk_target: int,
+                    pack_rows: bool = False) -> CorrLevelPlan:
+    """The fused correlation kernel's block plan for one pyramid level —
+    the exact padding/blocking arithmetic ``_lookup_level`` executes."""
+    if h2 <= 0 or w2 <= 0:
+        raise ValueError(f"degenerate level {h2}x{w2}: the kernel "
+                         f"short-circuits these to zeros before planning")
+    t = q_blk if q >= q_blk else round_up(q, SUBLANE)
+    qp = round_up(q, t)
+    pack = max(1, LANE // w2) if pack_rows else 1
+    if pack > 1:
+        rows = -(-h2 // pack)                    # packed rows
+        w2p = round_up(pack * w2, LANE)          # = LANE
+    else:
+        rows = h2
+        w2p = round_up(w2, LANE)
+    h2_blk = max(1, min(rows, p_blk_target // w2p))
+    rows_padded = round_up(rows, h2_blk)
+    return CorrLevelPlan(t=t, qp=qp, pack=pack, w2p=w2p, h2_blk=h2_blk,
+                         rows=rows, rows_padded=rows_padded,
+                         n_pblocks=rows_padded // h2_blk)
+
+
+@dataclasses.dataclass(frozen=True)
+class GruRowPlan:
+    """Row-block geometry of one fused-GRU pallas_call."""
+
+    hp: int     # padded height (multiple of block_rows)
+    wc: int     # conv-output width (aligned row merges: multiple of 8)
+    wp: int     # stored width: wc + tap radius of zeros each side
+    n_rb: int   # row-block count (the k grid dimension)
+
+
+def gru_row_plan(h: int, w: int, block_rows: int) -> GruRowPlan:
+    """The fused GRU kernel's padding plan — the exact arithmetic
+    ``_gru_fused_impl`` executes before its pallas_call."""
+    if block_rows < GRU_HALO:
+        raise ValueError(f"block_rows must be >= {GRU_HALO} (the pass-1 "
+                         f"recompute halo), got {block_rows}")
+    hp = round_up(h, block_rows)
+    wc = round_up(w, SUBLANE)
+    wp = wc + (GRU_TAPS - 1)
+    return GruRowPlan(hp=hp, wc=wc, wp=wp, n_rb=hp // block_rows)
+
+
+def corr_vmem_envelope(config, bucket: Tuple[int, int],
+                       vmem_bytes: int = VMEM_BYTES) -> dict:
+    """Static VMEM envelope of the fused correlation kernel at ``bucket``.
+
+    Per level: the pallas_call's resident blocks (f1/coords/f2 in, window
+    out) plus the program's dominant intermediates (the [T, Pblk] corr
+    tile and the one-hot interpolation matrices), all float32 — the
+    kernel casts everything to f32 at entry (its dtype-policy contract),
+    so the envelope is compute-dtype-independent.
+    """
+    h, w = bucket
+    h0, w0 = h // 8, w // 8
+    q = h0 * w0
+    n = 2 * config.corr_radius + 1
+    c = config.fnet_dim
+    levels = []
+    worst = 0
+    h2, w2 = h0, w0
+    for level in range(config.corr_levels):
+        if h2 <= 0 or w2 <= 0:
+            levels.append({"level": level, "shape": [h2, w2],
+                           "degenerate": True})
+            continue
+        plan = corr_level_plan(q, h2, w2, q_blk=config.pallas_q_blk,
+                               p_blk_target=config.pallas_p_blk,
+                               pack_rows=config.pallas_pack)
+        pblk = plan.h2_blk * plan.w2p
+        floats = (plan.t * c                 # f1 block
+                  + plan.t * 2               # coords block
+                  + pblk * c                 # f2 row block
+                  + plan.t * n * n           # output window block
+                  + plan.t * pblk            # corr tile (the MXU product)
+                  + plan.t * n * plan.h2_blk     # A_y one-hot
+                  + 2 * plan.t * n * plan.w2p)   # A_x + win_y
+        bytes_ = 4 * floats
+        worst = max(worst, bytes_)
+        levels.append({"level": level, "shape": [h2, w2],
+                       "block_bytes": bytes_,
+                       "fits": bytes_ <= vmem_bytes,
+                       "plan": dataclasses.asdict(plan)})
+        h2, w2 = h2 // 2, w2 // 2            # avg_pool2d(2, 2) per level
+    checks = []
+    if config.pallas_q_blk % SUBLANE:
+        checks.append(f"pallas_q_blk={config.pallas_q_blk} is not a "
+                      f"multiple of the {SUBLANE}-row sublane")
+    active = config.corr_impl == "pallas"
+    overflow = [lv for lv in levels if lv.get("block_bytes", 0) > vmem_bytes]
+    if overflow:
+        checks.append(
+            f"corr kernel level(s) {[lv['level'] for lv in overflow]} "
+            f"need {max(lv['block_bytes'] for lv in overflow)} B of VMEM "
+            f"(> {vmem_bytes}); shrink pallas_p_blk or pallas_q_blk")
+    return {"active": active, "worst_block_bytes": worst,
+            "vmem_bytes": vmem_bytes, "fits": not overflow,
+            "levels": levels, "checks": checks}
+
+
+def gru_vmem_envelope(config, bucket: Tuple[int, int], motion_dim: int,
+                      vmem_bytes: int = VMEM_BYTES) -> dict:
+    """Static VMEM envelope of the fused GRU kernel at ``bucket``.
+
+    Resident per program: 3 row-picks (prev/cur/next) of the [h|motion]
+    map and both hoisted-context stacks at the activation dtype, the six
+    fused gate-weight blocks at f32, and the output row block.  The
+    recompute-halo arithmetic (``GRU_HALO`` extra pass-1 rows per block)
+    is inside :func:`gru_row_plan`'s padding, which this shares with the
+    kernel.
+    """
+    h, w = bucket
+    hg, wg = h // 8, w // 8
+    t = config.gru_block_rows
+    checks = []
+    if t < GRU_HALO:
+        checks.append(f"gru_block_rows={t} < the {GRU_HALO}-row recompute "
+                      f"halo — the kernel rejects this at call time")
+        t = GRU_HALO
+    plan = gru_row_plan(hg, wg, t)
+    hidden = config.hidden_dim
+    act_itemsize = 2 if config.compute_dtype == "bfloat16" else 4
+    hm_ch = hidden + motion_dim
+    ctx_ch = 3 * hidden                      # z/r/q hoisted terms stacked
+    act = (3 * t * plan.wp * hm_ch           # hm prev/cur/next blocks
+           + 2 * 3 * t * plan.wp * ctx_ch    # c1 + c2 prev/cur/next
+           + t * plan.wc * hidden)           # output block
+    weights = 2 * GRU_TAPS * (hm_ch * 2 * hidden      # wzr{1,2}
+                              + hidden * hidden        # wqh{1,2}
+                              + motion_dim * hidden)   # wqm{1,2}
+    bytes_ = act * act_itemsize + weights * 4
+    active = config.gru_impl == "pallas" and not config.small
+    if bytes_ > vmem_bytes:
+        checks.append(f"gru kernel row blocks need {bytes_} B of VMEM "
+                      f"(> {vmem_bytes}); shrink gru_block_rows")
+    return {"active": active, "block_bytes": bytes_,
+            "vmem_bytes": vmem_bytes, "fits": bytes_ <= vmem_bytes,
+            "motion_dim": motion_dim, "plan": dataclasses.asdict(plan),
+            "checks": checks}
+
+
+# ---------------------------------------------------------------------------
+# eval_shape memory model (jax imported lazily from here down).
+# ---------------------------------------------------------------------------
+
+def bytes_of(spec) -> int:
+    """Device bytes of one abstract array (anything with .shape/.dtype)."""
+    import numpy as np
+    n = 1
+    for d in spec.shape:
+        n *= int(d)
+    return n * np.dtype(spec.dtype).itemsize
+
+
+def tree_bytes(tree) -> int:
+    import jax
+    return sum(bytes_of(leaf) for leaf in jax.tree.leaves(tree))
+
+
+def _resolved_config(config, sconfig):
+    if sconfig.iters_policy is not None:
+        config = dataclasses.replace(config,
+                                     iters_policy=sconfig.iters_policy)
+    return config
+
+
+def param_specs(config):
+    """Abstract shapes/dtypes of the full parameter tree — eval_shape over
+    the real initializer, so a variant or dtype change flows through."""
+    import jax
+
+    from ..config import init_rng
+    from ..models.raft import init_raft
+    return jax.eval_shape(lambda k: init_raft(k, config), init_rng(0))
+
+
+def _motion_dim(pspecs, config) -> int:
+    """Motion-feature channel count, derived from the gate-conv input
+    width exactly as the kernels derive it (hx = [h, ctx, motion])."""
+    gru = pspecs["update_block"]["gru"]
+    conv = gru.get("convz1", gru.get("convz"))
+    return int(conv["w"].shape[2]) - config.hidden_dim - config.context_dim
+
+
+def feature_specs(config, pspecs, h: int, w: int, b: int = 1):
+    """(fmap, cnet) abstract specs for a [b, h, w, 3] frame — the same
+    eval_shape the engine's ``_feature_shapes`` runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.raft import make_encode_fn
+    img = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
+    return jax.eval_shape(make_encode_fn(config), pspecs, img)
+
+
+def slot_specs(config, pspecs, h: int, w: int, capacity: int):
+    """The per-bucket SlotPool buffer specs ([capacity+1, …] — the extra
+    row is the scratch slot), mirroring ``engine._slot_specs``."""
+    import jax
+    import jax.numpy as jnp
+    fs, cs = feature_specs(config, pspecs, h, w, 1)
+    cap1 = capacity + 1
+    return (jax.ShapeDtypeStruct((cap1,) + fs.shape[1:], fs.dtype),
+            jax.ShapeDtypeStruct((cap1,) + cs.shape[1:], cs.dtype),
+            jax.ShapeDtypeStruct((cap1, h // 8, w // 8, 2), jnp.float32))
+
+
+def kind_footprint(config, pspecs, key: Key, capacity: int,
+                   donation: bool = True) -> dict:
+    """Per-executable device-memory footprint, mirroring the input/output
+    signature ``engine._compile`` lowers for this key.
+
+    ``transient_bytes`` is what one call of this executable holds LIVE
+    beyond the steady-state residents (params + pool buffers): its
+    non-resident inputs plus its outputs, with donated buffers aliased
+    away (a scommit's output pool buffers reuse the donated inputs'
+    memory off-CPU; on the CPU backend donation is off and the scatter
+    really is a copy — pass ``donation=False`` to model that).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import adaptive_iters
+    from ..models.raft import (make_counted_inference_fn, make_encode_fn,
+                               make_inference_fn, make_stream_batch_step_fn,
+                               make_stream_step_fn)
+    from ..serving.session import make_slot_commit_fn, make_slot_poison_fn
+
+    kind, h, w, b, _policy = key
+    img = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
+    flow = jax.ShapeDtypeStruct((b, h // 8, w // 8, 2), jnp.float32)
+    idx = jax.ShapeDtypeStruct((b,), jnp.int32)
+    mask = jax.ShapeDtypeStruct((b,), jnp.bool_)
+    pool = slot_specs(config, pspecs, h, w, capacity)
+    pool_b = sum(bytes_of(s) for s in pool)
+    donated: Sequence = ()
+    resident_inputs: Sequence = ()
+
+    if kind == "pair":
+        make = (make_counted_inference_fn
+                if adaptive_iters(config.iters_policy) else make_inference_fn)
+        out = jax.eval_shape(make(config), pspecs, img, img)
+        inputs = (img, img)
+    elif kind == "encode":
+        out = jax.eval_shape(make_encode_fn(config), pspecs, img)
+        inputs = (img,)
+    elif kind == "stream":
+        fs, cs = feature_specs(config, pspecs, h, w, b)
+        out = jax.eval_shape(make_stream_step_fn(config), pspecs, img, fs,
+                             cs, flow)
+        inputs = (img, fs, cs, flow)
+    elif kind == "sbatch":
+        out = jax.eval_shape(make_stream_batch_step_fn(config), pspecs,
+                             img, *pool, idx, mask)
+        inputs = (img, idx, mask)
+        resident_inputs = pool
+    elif kind == "scommit":
+        fs, cs = feature_specs(config, pspecs, h, w, b)
+        out = jax.eval_shape(make_slot_commit_fn(), *pool, idx, fs, cs,
+                             flow, mask)
+        inputs = (idx, fs, cs, flow, mask)
+        resident_inputs = pool
+        if donation:
+            donated = pool               # outputs alias the donated buffers
+    elif kind == "spoison":
+        out = jax.eval_shape(make_slot_poison_fn(), pool[0], idx)
+        inputs = (idx,)
+        resident_inputs = (pool[0],)
+        if donation:
+            donated = (pool[0],)
+    elif kind == "szero":
+        # builds the resident pool buffers themselves: nothing transient
+        out = pool
+        inputs = ()
+    else:
+        raise ValueError(f"unknown executable kind {kind!r}")
+
+    in_b = sum(bytes_of(s) for s in jax.tree.leaves(list(inputs)))
+    out_b = tree_bytes(out)
+    don_b = sum(bytes_of(s) for s in donated)
+    if kind == "szero":
+        transient = 0
+    else:
+        transient = in_b + max(0, out_b - don_b)
+    return {"key": list(key), "input_bytes": in_b, "output_bytes": out_b,
+            "donated_bytes": don_b, "transient_bytes": transient,
+            "pool_bytes": pool_b if resident_inputs or kind == "szero"
+            else 0}
+
+
+def config_signature(config, sconfig, stream: bool, chaos: bool) -> dict:
+    """What the committed-baseline comparison keys on: every knob that
+    changes the compile surface or the footprint model."""
+    return {
+        "small": config.small,
+        "compute_dtype": config.compute_dtype,
+        "buckets": [list(b) for b in sconfig.buckets],
+        "batch_steps": list(sconfig.batch_steps),
+        "max_sessions": sconfig.max_sessions,
+        "stream": stream,
+        "chaos": chaos,
+        "policy": resolved_policy(config, sconfig),
+    }
+
+
+def analyze(config, sconfig, device_kind: str = "tpu-v4",
+            stream: Optional[bool] = None, chaos: Optional[bool] = None,
+            donation: Optional[bool] = None) -> dict:
+    """The full static capacity report (the BUDGET.json payload).
+
+    ``donation`` defaults to the device kind's behavior: the engine turns
+    buffer donation off on the CPU backend, so the cpu model counts the
+    scatter outputs as real copies.
+    """
+    import jax  # noqa: F401 — fail here, loudly, if jax is unavailable
+
+    if device_kind not in DEVICE_BUDGETS:
+        raise ValueError(f"unknown device kind {device_kind!r}; "
+                         f"options: {sorted(DEVICE_BUDGETS)}")
+    budget = DEVICE_BUDGETS[device_kind]
+    if stream is None:
+        stream = sconfig.max_sessions > 0
+    if chaos is None:
+        chaos = sconfig.chaos is not None
+    if donation is None:
+        donation = device_kind != "cpu"
+    rconfig = _resolved_config(config, sconfig)
+    keys = enumerate_warmup_grid(rconfig, sconfig, stream=stream,
+                                 chaos=chaos)
+    capacity = max(1, sconfig.max_sessions)
+    pspecs = param_specs(rconfig)
+    params_b = tree_bytes(pspecs)
+    motion = _motion_dim(pspecs, rconfig)
+
+    by_kind: Dict[str, int] = {}
+    for k in keys:
+        by_kind[k[0]] = by_kind.get(k[0], 0) + 1
+
+    buckets = []
+    resident = params_b
+    peak_transient = 0
+    session_row_b = 0
+    violations: List[str] = []
+    for (bh, bw) in sconfig.buckets:
+        pool = slot_specs(rconfig, pspecs, bh, bw, capacity)
+        pool_b = sum(bytes_of(s) for s in pool)
+        row_b = sum(bytes_of(s) // (capacity + 1) for s in pool)
+        kinds = [kind_footprint(rconfig, pspecs, k, capacity,
+                                donation=donation)
+                 for k in keys if (k[1], k[2]) == (bh, bw)]
+        bucket_peak = max((f["transient_bytes"] for f in kinds), default=0)
+        peak_transient = max(peak_transient, bucket_peak)
+        if stream:
+            resident += pool_b
+            session_row_b += row_b
+        corr_env = corr_vmem_envelope(rconfig, (bh, bw),
+                                      budget["vmem_bytes"])
+        gru_env = gru_vmem_envelope(rconfig, (bh, bw), motion,
+                                    budget["vmem_bytes"])
+        for env, name in ((corr_env, "corr_pallas"), (gru_env,
+                                                      "gru_pallas")):
+            if env["active"] and not env["fits"]:
+                violations.append(f"{name} @ {bh}x{bw}: " +
+                                  "; ".join(env["checks"]))
+        buckets.append({
+            "bucket": [bh, bw],
+            "pool_bytes": pool_b if stream else 0,
+            "per_session_bytes": row_b if stream else 0,
+            "peak_transient_bytes": bucket_peak,
+            "kinds": kinds,
+            "pallas": {"corr": corr_env, "gru": gru_env},
+        })
+
+    peak = resident + peak_transient
+    headroom = budget["hbm_bytes"] - peak
+    max_sessions_fit = None
+    if stream and session_row_b > 0:
+        # resident(S) = params + sum_b (S+1) * row_b; solve the largest S
+        # with resident(S) + peak_transient <= hbm (transient is
+        # S-independent: pool buffers enter calls as residents)
+        free = (budget["hbm_bytes"] - params_b - peak_transient
+                - session_row_b)                       # the scratch rows
+        max_sessions_fit = max(0, free // session_row_b)
+        if sconfig.max_sessions > max_sessions_fit:
+            violations.append(
+                f"max_sessions={sconfig.max_sessions} does not fit "
+                f"{device_kind}: at most {max_sessions_fit} session(s) "
+                f"leave room for params + peak call buffers")
+    if headroom < 0:
+        violations.append(
+            f"estimated peak {peak} B exceeds the {device_kind} HBM "
+            f"budget {budget['hbm_bytes']} B by {-headroom} B")
+
+    return {
+        "version": 1,
+        "device_kind": device_kind,
+        "donation": donation,
+        "config_signature": config_signature(rconfig, sconfig, stream,
+                                             chaos),
+        "grid": {"size": len(keys), "by_kind": by_kind,
+                 "keys": [list(k) for k in keys]},
+        "params_bytes": params_b,
+        "buckets": buckets,
+        "totals": {
+            "resident_bytes": resident,
+            "peak_transient_bytes": peak_transient,
+            "peak_bytes": peak,
+            "hbm_budget_bytes": budget["hbm_bytes"],
+            "headroom_bytes": headroom,
+            "per_session_bytes": session_row_b or None,
+            "max_sessions_fit": max_sessions_fit,
+        },
+        "violations": violations,
+    }

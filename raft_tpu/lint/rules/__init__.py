@@ -5,4 +5,4 @@ import it below to extend the suite."""
 from . import (r1_side_effects, r2_recompile, r3_prng, r4_dtype,  # noqa: F401
                r5_where_grad, r6_host_sync, r7_donation,
                r8_stop_gradient, r9_contracts, r10_print,
-               c_concurrency)
+               b_budget, c_concurrency)

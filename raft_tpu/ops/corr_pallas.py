@@ -42,12 +42,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..lint.budget import corr_level_plan
 from ..lint.contracts import contract
 from .corr import fmap2_pyramid, lookup_blockwise_onehot
-
-
-def _round_up(x: int, m: int) -> int:
-    return -(-x // m) * m
 
 
 def _use_interpret() -> bool:
@@ -275,8 +272,11 @@ def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
         # is fully out of bounds -> zeros padding
         return jnp.zeros((B, Q, n * n), jnp.float32)
 
-    T = q_blk if Q >= q_blk else _round_up(Q, 8)
-    Qp = _round_up(Q, T)
+    # All padding/blocking arithmetic lives in lint/budget.py — the static
+    # VMEM budget analyzer checks the very plan this call executes.
+    plan = corr_level_plan(Q, H2, W2, q_blk=q_blk,
+                           p_blk_target=p_blk_target, pack_rows=pack_rows)
+    T, Qp = plan.t, plan.qp
     if Qp != Q:
         f1 = jnp.pad(f1, ((0, 0), (0, Qp - Q), (0, 0)))
         # edge-pad coords (not zeros): padded queries' windows then stay
@@ -288,17 +288,14 @@ def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
     # Row packing: when the real row width W2 uses at most half the 128
     # lanes, lay `pack` consecutive rows side by side in one packed row so
     # the corr tile covers pack x more of the map (no lane-padding waste).
-    pack = max(1, 128 // W2) if pack_rows else 1
+    pack, W2p, h2_blk = plan.pack, plan.w2p, plan.h2_blk
+    n_pblocks = plan.n_pblocks
     if pack > 1:
-        H2pk = -(-H2 // pack)                # packed rows
-        W2p = _round_up(pack * W2, 128)      # = 128
-        h2_blk = max(1, min(H2pk, p_blk_target // W2p))
-        H2pkp = _round_up(H2pk, h2_blk)
+        H2pkp = plan.rows_padded             # packed rows, block-padded
         f2 = jnp.pad(f2, ((0, 0), (0, H2pkp * pack - H2), (0, 0), (0, 0)))
         f2 = f2.reshape(B, H2pkp, pack * W2, C)
         if W2p != pack * W2:
             f2 = jnp.pad(f2, ((0, 0), (0, 0), (0, W2p - pack * W2), (0, 0)))
-        n_pblocks = H2pkp // h2_blk
         body = functools.partial(
             _packed_body, level_scale=1.0 / (2.0 ** level),
             corr_scale=1.0 / (C ** 0.5), radius=radius, h2_blk=h2_blk,
@@ -309,14 +306,11 @@ def _lookup_level(f1: jax.Array, f2_level: jax.Array, coords: jax.Array,
         # correlate to zero, so any one-hot match on them contributes 0
         # (= zeros padding) — and the vector unit would have padded the
         # lanes anyway.
-        W2p = _round_up(W2, 128)
-        h2_blk = max(1, min(H2, p_blk_target // W2p))
-        H2p = _round_up(H2, h2_blk)
+        H2p = plan.rows_padded
         if H2p != H2 or W2p != W2:
             # zero rows/cols correlate to zero -> identical to zeros padding
             # at the image boundary.
             f2 = jnp.pad(f2, ((0, 0), (0, H2p - H2), (0, W2p - W2), (0, 0)))
-        n_pblocks = H2p // h2_blk
         body = functools.partial(
             _window_body, level_scale=1.0 / (2.0 ** level),
             corr_scale=1.0 / (C ** 0.5), radius=radius, h2_blk=h2_blk,

@@ -63,17 +63,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401 — TPU lowering
 
+from ..lint.budget import GRU_HALO, GRU_TAPS, gru_row_plan
 from ..lint.contracts import contract
 from ..telemetry.trace import stage
 from .conv import conv2d
 
-_HALO = 4      # pass-1 recompute halo rows: q2 reads r2*h1 at +-2, r2's conv +-2
-_K = 5         # separable tap count (1x5 / 5x1)
-_CTX2_HALO = 2  # pass-2 ctx terms are needed at the r2 rows only (+-2)
-
-
-def _round_up(x: int, m: int) -> int:
-    return -(-x // m) * m
+# Kernel geometry constants live in lint/budget.py so the static VMEM
+# analyzer and the kernel agree by construction (lint rule B4).
+_HALO = GRU_HALO   # pass-1 recompute halo rows: q2 reads r2*h1 at +-2,
+#                    r2's conv +-2
+_K = GRU_TAPS      # separable tap count (1x5 / 5x1)
+_CTX2_HALO = 2     # pass-2 ctx terms are needed at the r2 rows only (+-2)
 
 
 def _use_interpret() -> bool:
@@ -311,9 +311,11 @@ def _gru_fused_impl(p, h, motion, ctx, block_rows, interpret, impl):
     fw = jax.tree.map(lambda a: a.astype(jnp.float32),
                       fuse_gru_weights(p, hidden, ctx_dim))
 
-    Hp = _round_up(H, T)
-    Wc = _round_up(W, 8)          # conv-output width (aligned row merges)
-    Wp = Wc + 4                   # stored width: tap radius of zeros each side
+    # padding plan shared with the static VMEM analyzer (lint/budget.py):
+    # Hp multiple of T, Wc the aligned conv-output width, Wp = Wc + the
+    # tap radius of zeros each side
+    plan = gru_row_plan(H, W, T)
+    Hp, Wc, Wp = plan.hp, plan.wc, plan.wp
     pad = ((0, 0), (0, Hp - H), (2, Wp - W - 2), (0, 0))
     hm = jnp.pad(jnp.concatenate([h, motion.astype(io_dtype)], -1), pad)
     c1 = jnp.pad(_ctx_cat(ctx, "1").astype(io_dtype), pad)

@@ -23,6 +23,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from ..config import RAFTConfig, adaptive_iters
+from ..lint.budget import enumerate_warmup_grid
 from ..lint.concurrency import guarded_by
 from ..telemetry import spans as tlm_spans
 from ..telemetry.log import get_logger
@@ -255,26 +256,14 @@ class InferenceEngine:
         cache misses — `compile_misses` measures serve-time surprises."""
         t0 = time.monotonic()
         n = 0
-        grid = [(h, w, b, "pair") for (h, w) in self.sconfig.buckets
-                for b in self.sconfig.batch_steps]
-        if self.stream:
-            # encode covers session open + cold restart; "stream" is the
-            # cold batch-1 step; the continuous-batched step + its commit
-            # scatter warm at every declared batch width — PLUS width 1
-            # for "scommit" regardless (commit_row — session open / cold
-            # attach — always runs at width 1, and under --serve-dp the
-            # declared steps are multiples of N, never 1); "szero" builds
-            # the pool buffers (so a lazy/reset fill never compiles);
-            # "spoison" only exists for chaos drills
-            grid += [(h, w, 1, kind) for (h, w) in self.sconfig.buckets
-                     for kind in ("encode", "stream", "szero", "scommit")]
-            grid += [(h, w, b, kind) for (h, w) in self.sconfig.buckets
-                     for b in self.sconfig.batch_steps
-                     for kind in ("sbatch", "scommit")]
-            if self.faults is not None:
-                grid += [(h, w, 1, "spoison")
-                         for (h, w) in self.sconfig.buckets]
-        for (h, w, b, kind) in grid:
+        # the grid is enumerated by the static budget analyzer
+        # (lint/budget.py) and consumed here, so `raftlint --budget`
+        # capacity reports and the live compile surface are one list by
+        # construction — the parity test pins it anyway
+        grid = enumerate_warmup_grid(self.config, self.sconfig,
+                                     stream=self.stream,
+                                     chaos=self.faults is not None)
+        for (kind, h, w, b, _policy) in grid:
             key = self._key(h, w, b, kind)
             with self._lock:
                 if key in self._exec:

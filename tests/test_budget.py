@@ -1,0 +1,334 @@
+"""Static budget analyzer tests (lint/budget.py + raftlint --budget).
+
+Covers the ISSUE-16 acceptance surface: eval_shape byte accounting,
+SlotPool sizing and donation accounting, the Pallas block-plan arithmetic
+(shared with the kernels — identity-checked, not just value-checked),
+headroom monotonicity, EXACT grid-enumeration parity against a live warm
+engine, and the CLI gate (JSON output, oversized-config strict failure,
+grid-size regression vs a committed baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+jax = pytest.importorskip("jax")
+import numpy as np  # noqa: E402
+
+from raft_tpu.config import RAFTConfig, init_rng  # noqa: E402
+from raft_tpu.lint import budget  # noqa: E402
+from raft_tpu.serving.config import ServeConfig  # noqa: E402
+
+BUCKET = (32, 48)
+
+
+def small_serve(**kw) -> ServeConfig:
+    base = dict(buckets=(BUCKET,), max_batch=2, max_sessions=4, port=0)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def config():
+    return RAFTConfig.small_model(iters=2)
+
+
+@pytest.fixture(scope="module")
+def pspecs(config):
+    return budget.param_specs(config)
+
+
+# ---------------------------------------------------------------- bytes
+
+
+def test_bytes_of_matches_numpy():
+    spec = jax.ShapeDtypeStruct((3, 5, 7), jax.numpy.bfloat16)
+    assert budget.bytes_of(spec) == 3 * 5 * 7 * 2
+    assert budget.bytes_of(jax.ShapeDtypeStruct((), jax.numpy.float32)) == 4
+
+
+def test_param_specs_match_real_init(config, pspecs):
+    # the abstract tree and a real init agree leaf-for-leaf — the byte
+    # model counts exactly what a replica loads
+    from raft_tpu.models.raft import init_raft
+    params = init_raft(init_rng(0), config)
+    real = sum(a.size * a.dtype.itemsize for a in jax.tree.leaves(params))
+    assert budget.tree_bytes(pspecs) == real > 0
+
+
+def test_slot_specs_shapes(config, pspecs):
+    h, w = BUCKET
+    fs, cs, flow = budget.slot_specs(config, pspecs, h, w, capacity=4)
+    assert fs.shape[0] == cs.shape[0] == flow.shape[0] == 5  # cap + scratch
+    assert flow.shape == (5, h // 8, w // 8, 2)
+    assert fs.shape[1:3] == cs.shape[1:3] == (h // 8, w // 8)
+
+
+# ------------------------------------------------------------ enumeration
+
+
+def test_enumeration_pairwise_only(config):
+    sconfig = small_serve(max_sessions=0)
+    keys = budget.enumerate_warmup_grid(config, sconfig)
+    assert {k[0] for k in keys} == {"pair"}
+    assert len(keys) == len(sconfig.batch_steps)
+
+
+def test_enumeration_stream_kinds_and_dedup(config):
+    sconfig = small_serve(max_batch=1)   # batch_steps == (1,)
+    keys = budget.enumerate_warmup_grid(config, sconfig)
+    # scommit@1 appears in both the width-1 block and the per-step block:
+    # deduplicated exactly like the engine's `if key in self._exec` skip
+    assert len(keys) == len(set(keys))
+    assert {k[0] for k in keys} == {"pair", "encode", "stream", "szero",
+                                    "scommit", "sbatch"}
+    assert ("spoison", *BUCKET, 1, "fixed") not in keys
+    chaos_keys = budget.enumerate_warmup_grid(config, sconfig, chaos=True)
+    assert ("spoison", *BUCKET, 1, "fixed") in chaos_keys
+
+
+def test_enumeration_policy_resolution(config):
+    sconfig = small_serve(iters_policy="converge:1e-2")
+    keys = budget.enumerate_warmup_grid(config, sconfig)
+    assert {k[4] for k in keys} == {"converge:1e-2"}
+
+
+def test_grid_parity_with_live_warm_engine(config):
+    """THE acceptance pin: analyzer enumeration == live warmup key set,
+    zero missing, zero extra."""
+    from raft_tpu.models.raft import init_raft
+    from raft_tpu.serving.engine import InferenceEngine
+    sconfig = small_serve(max_batch=1, max_sessions=2)
+    params = init_raft(init_rng(0), config)
+    eng = InferenceEngine(config, params, sconfig, stream=True)
+    eng.warmup(verbose=False)
+    expected = budget.enumerate_warmup_grid(config, sconfig, stream=True,
+                                            chaos=False)
+    assert sorted(expected) == list(eng.keys())
+    assert len(expected) == eng.executables
+
+
+# ------------------------------------------------------- kernel planning
+
+
+def test_corr_level_plan_values():
+    plan = budget.corr_level_plan(24, 4, 6, q_blk=128, p_blk_target=4096)
+    assert (plan.t, plan.qp, plan.pack) == (24, 24, 1)
+    assert plan.w2p == 128                       # lane padding
+    assert plan.h2_blk == 4 and plan.n_pblocks == 1
+    # full-scale level 0 at 432x1024: Q = 54*128, map 54x128
+    plan = budget.corr_level_plan(54 * 128, 54, 128, q_blk=128,
+                                  p_blk_target=4096)
+    assert plan.t == 128 and plan.w2p == 128
+    assert plan.h2_blk == 32 and plan.rows_padded == 64
+    assert plan.n_pblocks == 2
+
+
+def test_corr_level_plan_packing():
+    # 8-wide rows pack 16 per lane row
+    plan = budget.corr_level_plan(64, 32, 8, q_blk=128, p_blk_target=4096,
+                                  pack_rows=True)
+    assert plan.pack == 16
+    assert plan.rows == 2                        # ceil(32 / 16)
+    assert plan.w2p == 128
+    with pytest.raises(ValueError):
+        budget.corr_level_plan(64, 0, 8, q_blk=128, p_blk_target=4096)
+
+
+def test_gru_row_plan_halo_arithmetic():
+    plan = budget.gru_row_plan(30, 41, 8)
+    assert (plan.hp, plan.wc, plan.wp, plan.n_rb) == (32, 48, 52, 4)
+    with pytest.raises(ValueError):
+        budget.gru_row_plan(30, 41, budget.GRU_HALO - 1)
+
+
+def test_kernels_share_the_budget_plan_helpers():
+    # identity, not equality: the kernels must execute the SAME functions
+    # the analyzer budgets with (lint rule B4's structural guarantee)
+    from raft_tpu.ops import corr_pallas, gru_pallas
+    assert corr_pallas.corr_level_plan is budget.corr_level_plan
+    assert gru_pallas.gru_row_plan is budget.gru_row_plan
+    assert gru_pallas._HALO == budget.GRU_HALO
+    assert gru_pallas._K == budget.GRU_TAPS
+
+
+def test_vmem_envelopes(config):
+    corr = budget.corr_vmem_envelope(config, BUCKET)
+    assert corr["fits"] and not corr["active"]    # small model: dense corr
+    assert corr["worst_block_bytes"] > 0
+    assert len(corr["levels"]) == config.corr_levels
+    full = RAFTConfig.full()
+    env = budget.corr_vmem_envelope(full, (432, 1024))
+    assert env["fits"] and env["worst_block_bytes"] < budget.VMEM_BYTES
+    # a huge Q-block makes the [T, Pblk] corr tile alone blow VMEM — the
+    # envelope must overflow and say so
+    fat = RAFTConfig.full(pallas_q_blk=4096, corr_impl="pallas")
+    env = budget.corr_vmem_envelope(fat, (432, 1024))
+    assert not env["fits"] and env["active"] and env["checks"]
+
+
+def test_gru_vmem_envelope_scales_with_block_rows():
+    full = RAFTConfig.full()
+    small_rows = budget.gru_vmem_envelope(full, (432, 1024), 128)
+    fat = RAFTConfig.full(gru_block_rows=64)
+    big_rows = budget.gru_vmem_envelope(fat, (432, 1024), 128)
+    assert big_rows["block_bytes"] > small_rows["block_bytes"]
+    assert big_rows["plan"]["n_rb"] < small_rows["plan"]["n_rb"]
+
+
+# ------------------------------------------------------ memory model
+
+
+def test_donation_accounting_scommit(config, pspecs):
+    h, w = BUCKET
+    key = ("scommit", h, w, 1, "fixed")
+    donated = budget.kind_footprint(config, pspecs, key, capacity=4,
+                                    donation=True)
+    copied = budget.kind_footprint(config, pspecs, key, capacity=4,
+                                   donation=False)
+    pool_bytes = sum(budget.bytes_of(s) for s in
+                     budget.slot_specs(config, pspecs, h, w, 4))
+    # donated outputs alias the input pool buffers; without donation the
+    # scatter materializes a full second copy of the pool
+    assert donated["donated_bytes"] == pool_bytes
+    assert copied["donated_bytes"] == 0
+    assert (copied["transient_bytes"] - donated["transient_bytes"]
+            == pool_bytes)
+
+
+def test_szero_builds_residents_not_transients(config, pspecs):
+    h, w = BUCKET
+    fp = budget.kind_footprint(config, pspecs, ("szero", h, w, 1, "fixed"),
+                               capacity=4)
+    assert fp["transient_bytes"] == 0
+    assert fp["output_bytes"] == fp["pool_bytes"] > 0
+
+
+def test_pair_footprint_scales_with_batch(config, pspecs):
+    h, w = BUCKET
+    f1 = budget.kind_footprint(config, pspecs, ("pair", h, w, 1, "fixed"),
+                               capacity=1)
+    f2 = budget.kind_footprint(config, pspecs, ("pair", h, w, 2, "fixed"),
+                               capacity=1)
+    assert f2["input_bytes"] == 2 * f1["input_bytes"]
+    assert f2["transient_bytes"] > f1["transient_bytes"]
+
+
+def test_analyze_report_shape_and_headroom_monotone(config):
+    reports = [budget.analyze(config, small_serve(max_sessions=s),
+                              device_kind="cpu")
+               for s in (2, 8, 32)]
+    heads = [r["totals"]["headroom_bytes"] for r in reports]
+    assert heads[0] > heads[1] > heads[2]        # monotone in max_sessions
+    rep = reports[0]
+    assert rep["grid"]["size"] == len(rep["grid"]["keys"])
+    assert rep["totals"]["peak_bytes"] == (
+        rep["totals"]["resident_bytes"]
+        + rep["totals"]["peak_transient_bytes"])
+    assert rep["violations"] == []
+    # the closed-form fit bound is consistent with its own model: the
+    # fitted session count must itself pass, one more must not
+    fit = rep["totals"]["max_sessions_fit"]
+    per = rep["totals"]["per_session_bytes"]
+    hbm = rep["totals"]["hbm_budget_bytes"]
+    used_at_fit = (rep["params_bytes"] + (fit + 1) * per
+                   + rep["totals"]["peak_transient_bytes"])
+    assert used_at_fit <= hbm < used_at_fit + per
+
+
+def test_analyze_flags_oversized_sessions(config):
+    rep = budget.analyze(config, small_serve(max_sessions=10_000_000),
+                         device_kind="cpu")
+    assert any("does not fit" in v for v in rep["violations"])
+    assert any("exceeds" in v for v in rep["violations"])
+
+
+def test_analyze_cpu_disables_donation_by_default(config):
+    cpu = budget.analyze(config, small_serve(), device_kind="cpu")
+    tpu = budget.analyze(config, small_serve(), device_kind="tpu-v4")
+    assert cpu["donation"] is False and tpu["donation"] is True
+    # CPU commits copy the pool => strictly larger peak transients
+    assert (cpu["totals"]["peak_transient_bytes"]
+            >= tpu["totals"]["peak_transient_bytes"])
+
+
+# ------------------------------------------------------------- CLI gate
+
+
+def _budget_cli(tmp_path, *extra, serve="--small --buckets 32x48 "
+                "--max-batch 1 --max-sessions 2"):
+    import raftlint as rl
+    out = tmp_path / "BUDGET.json"
+    rc = rl.main(["--budget", "--device-kind", "cpu", "--serve-args",
+                  serve, "--budget-out", str(out), *extra])
+    return rc, (json.loads(out.read_text()) if out.exists() else None)
+
+
+def test_budget_cli_json_report(tmp_path, capsys):
+    rc, report = _budget_cli(tmp_path, "--json")
+    assert rc == 0
+    printed = json.loads(capsys.readouterr().out)
+    assert printed["grid"]["size"] == report["grid"]["size"] == 6
+    assert printed["violations"] == []
+    assert {tuple(k)[0] for k in report["grid"]["keys"]} == {
+        "pair", "encode", "stream", "szero", "scommit", "sbatch"}
+
+
+def test_budget_cli_strict_fails_oversized(tmp_path, capsys):
+    # the CI-gate acceptance: a config whose sessions blow the device
+    # budget exits non-zero under --strict
+    rc, report = _budget_cli(
+        tmp_path, "--strict",
+        serve="--small --buckets 32x48 --max-sessions 10000000")
+    assert rc == 1
+    assert report["strict_failures"]
+    assert "FAIL" in capsys.readouterr().err
+
+
+def test_budget_cli_strict_grid_regression(tmp_path, capsys):
+    rc, report = _budget_cli(tmp_path)
+    assert rc == 0
+    # commit a baseline with a SMALLER grid but the same signature: the
+    # current surface now reads as a cold-start regression
+    base = dict(report)
+    base["grid"] = dict(report["grid"], size=report["grid"]["size"] - 1)
+    baseline = tmp_path / "BASE.json"
+    baseline.write_text(json.dumps(base))
+    import raftlint as rl
+    rc = rl.main(["--budget", "--strict", "--device-kind", "cpu",
+                  "--serve-args", "--small --buckets 32x48 --max-batch 1 "
+                  "--max-sessions 2", "--budget-baseline", str(baseline)])
+    assert rc == 1
+    assert "compile surface grew" in capsys.readouterr().err
+    # different signature => no comparison, strict passes
+    rc = rl.main(["--budget", "--strict", "--device-kind", "cpu",
+                  "--serve-args", "--small --buckets 32x48 --max-batch 2 "
+                  "--max-sessions 2", "--budget-baseline", str(baseline)])
+    assert rc == 0
+
+
+def test_budget_cli_rejects_bad_serve_args(capsys):
+    import raftlint as rl
+    assert rl.main(["--budget", "--serve-args", "--frobnicate 3"]) == 2
+    assert "unknown" in capsys.readouterr().err
+
+
+def test_committed_budget_baseline_matches_default_config():
+    """BUDGET.json at the repo root IS the default-config tpu-v4 report —
+    regenerate with `tools/raftlint.py --budget --budget-out BUDGET.json`
+    when the surface deliberately changes."""
+    doc = json.loads((REPO / "BUDGET.json").read_text())
+    rep = budget.analyze(RAFTConfig.full(), ServeConfig(),
+                         device_kind="tpu-v4")
+    assert doc["config_signature"] == rep["config_signature"]
+    assert doc["grid"]["size"] == rep["grid"]["size"]
+    assert doc["grid"]["keys"] == rep["grid"]["keys"]
+    assert doc["violations"] == []

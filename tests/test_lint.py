@@ -482,6 +482,110 @@ class Meter:
         with self._lock:
             self.calls += 1
 """),
+    ("B1", """
+import jax
+
+def make_handler(fn):
+    step = jax.jit(fn)
+
+    def handle(request):
+        img = request["image"]
+        return step(img)
+    return handle
+""", """
+import jax
+
+def make_handler(fn, sconfig, pad_to_bucket):
+    step = jax.jit(fn)
+
+    def handle(request):
+        img = request["image"]
+        bucket = sconfig.route(img.shape[0], img.shape[1])
+        padded = pad_to_bucket(img, bucket)
+        return step(padded)
+    return handle
+"""),
+    ("B2", """
+class Engine:
+    def warmup(self):
+        for kind in ("pair", "encode"):
+            self._compile(kind)
+
+    def _compile(self, kind):
+        if kind == "pair":
+            return self._pair()
+        if kind == "encode":
+            return self._encode()
+        if kind == "stream":
+            return self._stream()
+""", """
+class Engine:
+    def warmup(self):
+        for kind in ("pair", "encode", "stream"):
+            self._compile(kind)
+
+    def _compile(self, kind):
+        if kind == "pair":
+            return self._pair()
+        if kind == "encode":
+            return self._encode()
+        if kind == "stream":
+            return self._stream()
+"""),
+    ("B2", """
+class Engine:
+    def warmup(self):
+        for key in enumerate_warmup_grid(self.config, self.sconfig):
+            self._compile(key)
+
+    def _compile(self, kind):
+        if kind == "pair":
+            return self._pair()
+        if kind == "spoison2":
+            return self._poison()
+
+def enumerate_warmup_grid(config, sconfig):
+    return [("pair", 432, 1024, 1, "fixed")]
+""", """
+class Engine:
+    def warmup(self):
+        for key in enumerate_warmup_grid(self.config, self.sconfig):
+            self._compile(key)
+
+    def _compile(self, kind):
+        if kind == "pair":
+            return self._pair()
+        if kind == "spoison2":
+            return self._poison()
+
+def enumerate_warmup_grid(config, sconfig):
+    return [("pair", 432, 1024, 1, "fixed"),
+            ("spoison2", 432, 1024, 1, "fixed")]
+"""),
+    ("B3", """
+import jax.numpy as jnp
+
+def handle_flow(request):
+    canvas = jnp.zeros((8, 8, 3), jnp.float32)
+    return canvas
+""", """
+import numpy as np
+
+def handle_flow(request):
+    canvas = np.zeros((8, 8, 3), np.float32)
+    return canvas
+"""),
+    ("B4", """
+VMEM_LIMIT = 16 * 1024 * 1024
+
+def fits(nbytes):
+    return nbytes <= VMEM_LIMIT
+""", """
+from raft_tpu.lint.budget import VMEM_BYTES
+
+def fits(nbytes):
+    return nbytes <= VMEM_BYTES
+"""),
 ]
 
 

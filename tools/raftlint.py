@@ -10,9 +10,16 @@
     python tools/raftlint.py --diff origin/main --strict   # pre-commit gate
     python tools/raftlint.py --write-baseline   # accept current findings
     python tools/raftlint.py --list-suppressions  # audit disable= escapes
+    python tools/raftlint.py --budget           # static capacity report
+    python tools/raftlint.py --budget --strict --device-kind tpu-v4 \\
+        --serve-args "--buckets 432x1024 --max-sessions 64"   # CI gate
 
 Pure stdlib + AST: nothing is imported or executed from the scanned tree,
 so this runs in well under a second with or without jax installed.
+(The one exception is ``--budget``, which evaluates abstract shapes
+through ``jax.eval_shape`` and therefore needs jax — still no device, no
+compile: it answers "what will the engine compile and does it fit HBM /
+VMEM" from config alone.  See LINT.md "B family" and lint/budget.py.)
 
 ``--diff [REV]`` scans only the .py files changed vs REV (plus untracked
 files), so the strict gate stays fast as the tree grows and works as a
@@ -182,6 +189,149 @@ def _list_suppressions(paths) -> int:
     return 0
 
 
+DEFAULT_BUDGET_BASELINE = REPO_ROOT / "BUDGET.json"
+
+
+def _parse_serve_args(spec: str):
+    """Parse a serve_bench-style arg string into (RAFTConfig, ServeConfig).
+
+    Understood tokens (a practical subset of tools/serve_bench.py /
+    tools/serve.py flags): --small, --buckets HxW[,HxW...], --max-batch N,
+    --batch-steps a,b,..., --max-sessions N, --iters-policy SPEC,
+    --iters N, --chaos SPEC, --dp-devices N, --compute-dtype D,
+    --corr-impl I, --gru-impl I.
+    """
+    import shlex
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.serving.config import ServeConfig, parse_buckets
+
+    toks = shlex.split(spec or "")
+    model, serve, small = {}, {}, False
+    i = 0
+
+    def value(flag):
+        nonlocal i
+        if i + 1 >= len(toks):
+            raise ValueError(f"{flag} needs a value")
+        i += 1
+        return toks[i]
+
+    while i < len(toks):
+        t = toks[i]
+        if t == "--small":
+            small = True
+        elif t == "--buckets":
+            serve["buckets"] = parse_buckets(value(t))
+        elif t == "--max-batch":
+            serve["max_batch"] = int(value(t))
+        elif t == "--batch-steps":
+            serve["batch_steps"] = tuple(
+                int(s) for s in value(t).split(","))
+        elif t == "--max-sessions":
+            serve["max_sessions"] = int(value(t))
+        elif t == "--iters-policy":
+            serve["iters_policy"] = value(t)
+        elif t == "--chaos":
+            serve["chaos"] = value(t)
+        elif t == "--dp-devices":
+            serve["dp_devices"] = int(value(t))
+        elif t == "--iters":
+            model["iters"] = int(value(t))
+        elif t == "--compute-dtype":
+            model["compute_dtype"] = value(t)
+        elif t == "--corr-impl":
+            model["corr_impl"] = value(t)
+        elif t == "--gru-impl":
+            model["gru_impl"] = value(t)
+        else:
+            raise ValueError(f"unknown --serve-args token {t!r}")
+        i += 1
+    config = (RAFTConfig.small_model(**model) if small
+              else RAFTConfig.full(**model))
+    return config, ServeConfig(**serve)
+
+
+def _budget_summary(report: dict) -> str:
+    mb = 1024.0 ** 2
+    t = report["totals"]
+    lines = [
+        f"budget [{report['device_kind']}] grid={report['grid']['size']} "
+        + " ".join(f"{k}:{n}" for k, n in
+                   sorted(report["grid"]["by_kind"].items())),
+        f"  params {report['params_bytes'] / mb:.1f} MB, resident "
+        f"{t['resident_bytes'] / mb:.1f} MB, peak {t['peak_bytes'] / mb:.1f}"
+        f" MB of {t['hbm_budget_bytes'] / mb:.0f} MB "
+        f"(headroom {t['headroom_bytes'] / mb:.1f} MB)",
+    ]
+    for b in report["buckets"]:
+        bh, bw = b["bucket"]
+        pal = b["pallas"]
+        lines.append(
+            f"  bucket {bh}x{bw}: pool {b['pool_bytes'] / mb:.1f} MB "
+            f"({b['per_session_bytes'] / 1024.0:.0f} KB/session), peak "
+            f"call {b['peak_transient_bytes'] / mb:.1f} MB, vmem "
+            f"corr {pal['corr']['worst_block_bytes'] / mb:.2f} MB"
+            f"{'*' if pal['corr']['active'] else ''} / gru "
+            f"{pal['gru']['block_bytes'] / mb:.2f} MB"
+            f"{'*' if pal['gru']['active'] else ''}")
+    if t["max_sessions_fit"] is not None:
+        configured = report["config_signature"]["max_sessions"]
+        lines.append(f"  max sessions that fit: {t['max_sessions_fit']} "
+                     f"(configured {configured})")
+    for v in report["violations"]:
+        lines.append(f"  VIOLATION: {v}")
+    return "\n".join(lines)
+
+
+def _run_budget(args) -> int:
+    """``--budget`` mode: static capacity report + strict gating."""
+    from raft_tpu.lint import budget
+    try:
+        config, sconfig = _parse_serve_args(args.serve_args)
+    except ValueError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
+    report = budget.analyze(config, sconfig, device_kind=args.device_kind)
+
+    failures = list(report["violations"])
+    baseline_path = (Path(args.budget_baseline) if args.budget_baseline
+                     else DEFAULT_BUDGET_BASELINE)
+    if not args.no_baseline and baseline_path.exists():
+        try:
+            base = json.loads(baseline_path.read_text())
+        except (OSError, ValueError) as e:
+            print(f"ERROR: unreadable budget baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        # grid-size regression only compares like with like: a different
+        # config signature legitimately has a different surface
+        if base.get("config_signature") == report["config_signature"] \
+                and report["grid"]["size"] > base["grid"]["size"]:
+            failures.append(
+                f"compile surface grew: {report['grid']['size']} "
+                f"executables vs {base['grid']['size']} in "
+                f"{baseline_path.name} — every extra key is warmup/"
+                f"cold-start time; regenerate the baseline deliberately "
+                f"with --budget --budget-out {baseline_path.name}")
+    report["strict_failures"] = failures
+
+    out = json.dumps(report, indent=2) + "\n"
+    if args.budget_out:
+        Path(args.budget_out).write_text(out)
+    if args.format == "json":
+        print(out, end="")
+    else:
+        print(_budget_summary(report))
+        if args.budget_out:
+            print(f"  wrote {args.budget_out}")
+    if args.strict and failures:
+        for f in failures:
+            print(f"raftlint budget: FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="raftlint",
@@ -196,6 +346,31 @@ def main(argv=None) -> int:
     p.add_argument("--ignore", default=None, metavar="R4",
                    help="skip these rule ids")
     p.add_argument("--format", default="text", choices=["text", "json"])
+    p.add_argument("--json", action="store_true",
+                   help="alias for --format json (machine-readable "
+                        "findings / budget report for CI annotations)")
+    p.add_argument("--budget", action="store_true",
+                   help="static capacity mode: enumerate the engine's "
+                        "warmup executable grid and the HBM/VMEM "
+                        "footprint for a serve config — no device, no "
+                        "compile (needs jax for eval_shape)")
+    p.add_argument("--device-kind", default="tpu-v4",
+                   choices=["tpu-v4", "tpu-v5e", "cpu"],
+                   help="device budget to solve headroom against "
+                        "(--budget mode)")
+    p.add_argument("--serve-args", default="", metavar="ARGS",
+                   help="serve_bench-style flag string describing the "
+                        "config to analyze, e.g. \"--buckets 432x1024 "
+                        "--max-sessions 64\" (--budget mode; default: "
+                        "the default serve config)")
+    p.add_argument("--budget-out", default=None, metavar="FILE",
+                   help="write the full BUDGET.json report here "
+                        "(--budget mode)")
+    p.add_argument("--budget-baseline", default=None, metavar="FILE",
+                   help=f"committed budget baseline for --strict "
+                        f"grid-size regression checks (default "
+                        f"{DEFAULT_BUDGET_BASELINE.name}; --no-baseline "
+                        f"disables)")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("--contracts", action="store_true",
                    help="list every @contract'd signature instead of linting")
@@ -218,9 +393,13 @@ def main(argv=None) -> int:
                         "escape (rule, file:line, age via git blame)")
     args = p.parse_args(argv)
 
+    if args.json:
+        args.format = "json"
     if args.list_rules:
         _list_rules()
         return 0
+    if args.budget:
+        return _run_budget(args)
     paths = args.paths or [str(REPO_ROOT / "raft_tpu")]
     if args.contracts:
         _dump_contracts(paths)

@@ -165,6 +165,57 @@ def scrape(host, port):
     return prom
 
 
+def budget_crosscheck(server, prom):
+    """Static capacity analysis vs the live engine (LINT.md B family).
+
+    Off-TPU the acceptance bar is exact: the warmup grid the engine
+    actually built must equal the static analyzer's enumeration — zero
+    missing keys, zero extra.  On TPU the watchdog HBM gauges (when
+    exported) land next to the static estimate, so every
+    BENCH_serving.json record carries static-vs-measured device memory.
+    Returns (record, problems)."""
+    import jax
+
+    from raft_tpu.lint import budget as lint_budget
+
+    engine = server.engine
+    problems = []
+    expected = lint_budget.enumerate_warmup_grid(
+        engine.config, engine.sconfig, stream=engine.stream,
+        chaos=engine.faults is not None)
+    live = list(engine.keys())
+    missing = sorted(set(expected) - set(live))
+    extra = sorted(set(live) - set(expected))
+    if engine.sconfig.warmup:
+        # without warmup the live cache only holds lazily-compiled keys,
+        # so exact parity is only meaningful on a warmed server
+        if missing:
+            problems.append(
+                f"{len(missing)} analyzer-enumerated warmup key(s) the "
+                f"engine never built: {missing[:4]}")
+        if extra:
+            problems.append(
+                f"{len(extra)} live executable(s) the static enumeration "
+                f"missed: {extra[:4]}")
+    device_kind = "tpu-v4" if jax.default_backend() == "tpu" else "cpu"
+    report = lint_budget.analyze(engine.config, engine.sconfig,
+                                 device_kind=device_kind,
+                                 stream=engine.stream,
+                                 chaos=engine.faults is not None)
+    measured = prom.get("raft_serving_hbm_bytes_in_use")
+    rec = {
+        "grid_static": len(expected), "grid_live": len(live),
+        "grid_match": not missing and not extra,
+        "device_kind": device_kind,
+        "static_resident_bytes": report["totals"]["resident_bytes"],
+        "static_peak_bytes": report["totals"]["peak_bytes"],
+        "max_sessions_fit": report["totals"]["max_sessions_fit"],
+        "hbm_measured_bytes": (int(measured) if measured is not None
+                               else None),
+    }
+    return rec, problems
+
+
 def make_session_frames(h, w, n, seed, shift=6):
     """A synthetic constant-velocity sequence: a procedural texture
     (data/synthetic.py octaves — image-like statistics, unlike white
@@ -607,6 +658,9 @@ def run_video_bench(args, host, port, server, config) -> int:
     stream_res, stream_s = run_video(host, port, seqs, stream=True,
                                      rate=rate)
     prom_stream = scrape(host, port)
+    budget_rec, budget_problems = (
+        budget_crosscheck(server, prom_stream) if server is not None
+        else (None, []))
     if server is not None:
         server.stop()
     cold_d = diff_prom(prom0, prom_cold)
@@ -695,6 +749,8 @@ def run_video_bench(args, host, port, server, config) -> int:
         "compile_misses_after_warmup": int(
             prom_stream.get("raft_serving_compile_cache_misses_total", -1)),
     }
+    if budget_rec is not None:
+        rec["budget"] = budget_rec
     from raft_tpu.telemetry import run_manifest
     rec["manifest"] = run_manifest(config=config, mode="serve_bench")
     print(json.dumps(rec, indent=2))
@@ -704,7 +760,7 @@ def run_video_bench(args, host, port, server, config) -> int:
         print(f"[bench] appended to {args.out}")
 
     if args.smoke:
-        problems = []
+        problems = list(budget_problems)
         bad = {k: v for k, v in statuses(cold_res + stream_res).items()
                if k != "200"}
         if bad:
@@ -999,6 +1055,9 @@ def main() -> int:
     conn.request("GET", "/metrics")
     prom = parse_prom(conn.getresponse().read().decode())
     conn.close()
+    budget_rec, budget_problems = (
+        budget_crosscheck(server, prom) if server is not None
+        else (None, []))
     if server is not None:
         server.stop()
 
@@ -1090,6 +1149,8 @@ def main() -> int:
         chaos_rec["lock_holds_observed"] = int(
             prom.get("raft_lock_hold_seconds_count", 0))
         rec["chaos"] = chaos_rec
+    if budget_rec is not None:
+        rec["budget"] = budget_rec
     # provenance (OBSERVABILITY.md): every BENCH_serving.json record carries
     # the run manifest — git sha, jax versions, device, config hash — so the
     # serving trajectory is attributable.  For --url (external server) the
@@ -1107,6 +1168,7 @@ def main() -> int:
     if args.smoke or chaos_problems:
         problems = list(chaos_problems)
         problems.extend(accounting_problems)
+        problems.extend(budget_problems)
         if not ok_lat:
             problems.append("no successful requests")
         if overhead is not None and overhead.get("overhead_pct") is not None \
