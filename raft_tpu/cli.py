@@ -40,10 +40,11 @@ def _build_parser() -> argparse.ArgumentParser:
                                 description="TPU-native RAFT optical flow")
     p.add_argument("-m", "--mode", default="test",
                    choices=["train", "val", "test", "export", "flops",
-                            "serve"],
+                            "serve", "serve_fleet"],
                    help="run mode (reference infer_raft.py:57-58 surface; "
                         "'serve' starts the long-lived micro-batching "
-                        "inference server — SERVING.md)")
+                        "inference server, 'serve_fleet' a replica fleet "
+                        "behind one router — SERVING.md)")
     p.add_argument("--im1", default="assets/frame_0016.png", help="left image")
     p.add_argument("--im2", default="assets/frame_0017.png", help="right image")
     p.add_argument("--load", default=None,
@@ -382,6 +383,34 @@ def _build_parser() -> argparse.ArgumentParser:
                         "recompile, and shutdown/SIGTERM (default "
                         "<--out>/flightrec.jsonl; '' disables the file, "
                         "GET /debug/traces still serves the ring)")
+    # serve_fleet mode (SERVING.md "Fleet"): N serve subprocesses behind
+    # one session-affinity router; every serve flag above is forwarded to
+    # each replica verbatim
+    p.add_argument("--replicas", type=int, default=2,
+                   help="serve_fleet mode: initial replica count")
+    p.add_argument("--min-replicas", type=int, default=None,
+                   help="serve_fleet mode: autoscaler floor (default 1)")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   help="serve_fleet mode: autoscaler/scale_to ceiling "
+                        "(default max(--replicas, 2))")
+    p.add_argument("--autoscale", action="store_true",
+                   help="serve_fleet mode: enable the signal-driven "
+                        "autoscaler (SLO burn rate, queue fill, shed rate, "
+                        "breaker state; hysteretic, see SERVING.md Fleet)")
+    p.add_argument("--fleet-port", type=int, default=None,
+                   help="serve_fleet mode: router bind port (default "
+                        "--port; replicas always bind ephemeral ports)")
+    p.add_argument("--pin-cpus", action="store_true",
+                   help="serve_fleet mode: pin each replica to a disjoint "
+                        "round-robin CPU-core slice (sched_setaffinity) so "
+                        "replicas scale cores instead of fighting for them")
+    p.add_argument("--health-poll-s", type=float, default=None,
+                   help="serve_fleet mode: replica /healthz + /metrics "
+                        "poll cadence — also the failure-detection clock "
+                        "(default 1.0)")
+    p.add_argument("--scale-poll-s", type=float, default=None,
+                   help="serve_fleet mode: autoscaler decision cadence "
+                        "(default 5.0)")
     return p
 
 
@@ -636,6 +665,13 @@ def mode_serve(args) -> int:
     return serve_cli(args, config, _load_params)
 
 
+def mode_serve_fleet(args) -> int:
+    from .fleet import serve_fleet_cli
+    config = _make_config(args)
+    _start_run_log(args, config)
+    return serve_fleet_cli(args, config, _load_params)
+
+
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     if args.demo_train:
@@ -671,7 +707,8 @@ def main(argv=None) -> int:
                    process_id=args.process_id)
     return {"test": mode_test, "flops": mode_flops, "export": mode_export,
             "val": mode_val, "train": mode_train,
-            "serve": mode_serve}[args.mode](args)
+            "serve": mode_serve,
+            "serve_fleet": mode_serve_fleet}[args.mode](args)
 
 
 if __name__ == "__main__":

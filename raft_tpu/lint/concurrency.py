@@ -81,6 +81,14 @@ def guarded_by(lock: str) -> _GuardSpec:
 # runtime (watchdogs.LockOrderValidator, armed via RAFT_TPU_LOCK_WATCH=1).
 # Documented — and generated-checked — in SERVING.md "Threading model".
 SERVING_LOCK_HIERARCHY: Tuple[str, ...] = (
+    "FleetSessionMap._lock",      # router session table (lookup only; the
+                                  # per-session lock is taken after release)
+    "FleetSession.lock",          # held across a whole routed advance —
+                                  # migration picks a replica under it
+    "ReplicaManager._lock",       # replica table; a migrating advance asks
+                                  # for a healthy replica while pinned
+    "FleetRouter._lock",          # leaf of the fleet plane: in-flight
+                                  # counters (taken after the manager view)
     "CircuitBreaker._lock",       # record() may demote ALL sessions (open)
     "SessionStore._lock",         # probes Session.lock.locked(), never takes
     "Session.lock",               # handler holds it across a whole advance

@@ -33,6 +33,12 @@ from .config import ServeConfig
 _log = get_logger("serve")
 
 
+class ReloadMismatch(ValueError):
+    """New params don't match the serving template (tree structure or a
+    leaf's shape/dtype differs, or the probe produced non-finite flow) —
+    the swap is rejected and the engine keeps serving the old weights."""
+
+
 class InferenceEngine:
     """(kind, bucket, batch, iters-policy) -> compiled executable, with
     hit/miss accounting.  ``kind`` is ``"pair"`` (the /v1/flow two-frame
@@ -70,6 +76,8 @@ class InferenceEngine:
     pair_calls = guarded_by("_lock")
     encode_calls = guarded_by("_lock")
     stream_calls = guarded_by("_lock")
+    weight_version = guarded_by("_lock")
+    weight_tag = guarded_by("_lock")
     _feature_specs = guarded_by("_spec_lock")
 
     def __init__(self, config: RAFTConfig, params, sconfig: ServeConfig,
@@ -150,6 +158,8 @@ class InferenceEngine:
         self.encode_calls = 0     # fnet-pass accounting: 1 per encode call,
         self.stream_calls = 0     # 1 per stream step (the acceptance
         self.pair_calls = 0       # criterion's counters), 2 per pair row
+        self.weight_version = 1   # bumped by reload(); healthz reports it
+        self.weight_tag = None
         self.warmup_seconds = 0.0
 
     # -- compile-cache bookkeeping ---------------------------------------
@@ -307,6 +317,77 @@ class InferenceEngine:
     def keys(self):
         with self._lock:
             return sorted(self._exec)
+
+    # -- zero-downtime weight hot-swap -------------------------------------
+
+    def weight_info(self) -> dict:
+        with self._lock:
+            return {"version": self.weight_version, "tag": self.weight_tag}
+
+    def reload(self, params, tag: Optional[str] = None,
+               probe: bool = True) -> dict:
+        """Atomically swap the serving weights for ``params`` without
+        touching the executable cache.  Every executable was AOT-compiled
+        with the params as a RUNTIME argument (``ex(self.params, ...)``)
+        specialized only on avals, so a new tree with identical structure
+        and leaf shape/dtype flows through every warm executable with
+        zero recompiles — the cache keys ``(kind, h, w, b, policy)`` stay
+        valid by construction.  Anything else is a template mismatch and
+        is rejected up front (:class:`ReloadMismatch`; the /admin/reload
+        endpoint maps it to 409), leaving the old weights serving.
+
+        The swap itself happens in three phases, all off the serving
+        path: stage (device upload, no lock held), probe (execute one
+        already-warm pair executable against the staged tree and check
+        the flow is finite — catches sharding/layout surprises, e.g.
+        under --serve-dp, before any request can see them), then a
+        single reference flip under ``_lock``.  In-flight device calls
+        read ``self.params`` once per call, so they finish on whichever
+        tree they started with — no request is ever dropped or torn."""
+        import jax
+        from jax.tree_util import tree_flatten_with_path
+
+        staged = jax.tree.map(jax.numpy.asarray, params)
+        old_paths, old_td = tree_flatten_with_path(self.params)
+        new_paths, new_td = tree_flatten_with_path(staged)
+        if old_td != new_td:
+            raise ReloadMismatch(
+                f"param tree structure differs: serving has "
+                f"{old_td.num_leaves} leaves, pushed tree has "
+                f"{new_td.num_leaves} (layout/naming mismatch)")
+        for (path, old), (_, new) in zip(old_paths, new_paths):
+            if (old.shape, old.dtype) != (new.shape, new.dtype):
+                name = jax.tree_util.keystr(path)
+                raise ReloadMismatch(
+                    f"leaf {name} differs: serving "
+                    f"{old.dtype}{list(old.shape)} vs pushed "
+                    f"{new.dtype}{list(new.shape)}")
+        probed = False
+        if probe:
+            # cheapest warm pair executable; _get_executable is a cache
+            # hit by construction (the key came out of the cache), so the
+            # probe can never be the compile the no-recompile gate hunts
+            pair_keys = [k for k in self.keys() if k[0] == "pair"]
+            if pair_keys:
+                kind, h, w, b, _pol = min(
+                    pair_keys, key=lambda k: k[1] * k[2] * k[3])
+                ex = self._get_executable(self._key(h, w, b, kind))
+                img = np.zeros((b, h, w, 3), np.float32)
+                out = ex(staged, img, img)
+                flow = np.asarray(out[0] if self.adaptive else out)
+                if not np.all(np.isfinite(flow)):
+                    raise ReloadMismatch(
+                        "probe produced non-finite flow; rejecting swap")
+                probed = True
+        with self._lock:
+            self.params = staged
+            self.weight_version += 1
+            self.weight_tag = tag
+            info = {"version": self.weight_version, "tag": tag,
+                    "probed": probed}
+        _log.info(f"hot-swapped weights -> version {info['version']}"
+                  f" tag={tag} probed={probed}")
+        return info
 
     # -- the device call --------------------------------------------------
 

@@ -4,6 +4,14 @@ Endpoints:
 
   POST /v1/flow       infer optical flow for one image pair
   POST /v1/stream     sessionful video flow: open / advance / close
+  POST /admin/reload  zero-downtime weight hot-swap: body is a native
+                      raft-tpu params npz ('/'-joined keys); the engine
+                      stages + probes + atomically flips (engine.reload).
+                      200 with the new weight version on success, 409 when
+                      the pushed tree doesn't match the serving template
+                      (shape/dtype/structure), 400 on an unreadable body.
+                      Optional X-Raft-Weight-Tag header names the push;
+                      default tag is the body's sha256 prefix.
   GET  /healthz       liveness/readiness (503 while draining)
   GET  /metrics       Prometheus text exposition
   GET  /debug/traces  flight-recorder view: recent + error request traces
@@ -288,6 +296,10 @@ class _Handler(BaseHTTPRequestHandler):
                         "restarts": app.supervisor.restarts,
                     },
                 }
+                # stub engines (tests) may not carry the hot-swap surface
+                winfo = getattr(app.engine, "weight_info", None)
+                if winfo is not None:
+                    health["weights"] = winfo()
                 if app.breaker is not None:
                     health["breaker"] = {"state": app.breaker.state,
                                          "opens": app.breaker.opens}
@@ -351,6 +363,9 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/v1/stream":
             self._post_stream()
             return
+        if path == "/admin/reload":
+            self._post_admin_reload()
+            return
         if path != "/v1/flow":
             self._send_json(404, {"error": f"no handler for {path}"})
             return
@@ -412,6 +427,41 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send_json(200, {"flow": req.result.tolist(),
                                       "meta": meta}, headers=headers)
+
+    def _post_admin_reload(self):
+        """Weight hot-swap: npz body -> engine.reload (stage + probe +
+        atomic flip).  The heavy work (device upload, probe execution)
+        happens on THIS handler thread — never the batcher thread — so
+        the serving path keeps draining batches throughout; the only
+        serialized moment is the reference flip under the engine lock."""
+        import hashlib
+
+        from ..convert.weights import load_params_npz
+        from .engine import ReloadMismatch
+        app = self.server_app
+        body = self._read_body()
+        if body is None:
+            return
+        try:
+            params = load_params_npz(io.BytesIO(body))
+            if not params:
+                raise ValueError("npz body holds no arrays")
+        except Exception as e:
+            app.count_request("bad_request")
+            self._send_json(400, {"error": f"could not read params npz: "
+                                           f"{e}"})
+            return
+        tag = (self.headers.get("X-Raft-Weight-Tag")
+               or hashlib.sha256(body).hexdigest()[:12])
+        try:
+            info = app.reload_params(params, tag=tag)
+        except ReloadMismatch as e:
+            self._send_json(409, {"error": str(e)})
+            return
+        except Exception as e:
+            self._send_json(500, {"error": f"reload failed: {e}"})
+            return
+        self._send_json(200, {"status": "reloaded", "weights": info})
 
     def _post_stream(self):
         app = self.server_app

@@ -279,6 +279,18 @@ class FlowServer:
     def count_request(self, status: str) -> None:
         self.metrics["requests"].labels(status).inc()
 
+    def reload_params(self, params, tag=None) -> dict:
+        """Zero-downtime weight hot-swap (POST /admin/reload): delegate to
+        engine.reload — stage off-lock, probe a warm executable, flip the
+        params reference atomically.  Serving never pauses; the run log
+        records the swap so ``tlm`` can attribute a quality shift to it."""
+        info = self.engine.reload(params, tag=tag)
+        run_log = tlm_events.current()
+        if run_log is not None:
+            run_log.event("serve_weights_reloaded", version=info["version"],
+                          tag=info.get("tag"), probed=info.get("probed"))
+        return info
+
     # -- self-healing hooks ------------------------------------------------
 
     def _batcher_crashed(self, exc: Exception) -> None:

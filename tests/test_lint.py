@@ -1136,7 +1136,8 @@ def test_serving_md_lock_table_matches_annotations():
     end = doc.index("<!-- lock-table:end -->")
     committed = doc[start + len("<!-- lock-table:start -->"):end].strip()
     generated = render_threading_table(
-        [str(REPO / "raft_tpu" / "serving")]).strip()
+        [str(REPO / "raft_tpu" / "serving"),
+         str(REPO / "raft_tpu" / "fleet")]).strip()
     assert committed == generated, (
         "SERVING.md lock table drifted from the annotations — replace the "
         "block between the lock-table markers with:\n\n" + generated)

@@ -434,6 +434,57 @@ def test_tlm_trace_list_render_and_attribution(tmp_path):
     assert tlm.main(["trace", str(log), "zzzz"]) == 1
 
 
+def test_tlm_joins_fleet_multi_hop_traces(tmp_path):
+    """A fleet request leaves one trace record per hop — the router's
+    route/forward view and the replica's admit/execute view, sharing the
+    propagated trace id.  tlm must join them into ONE waterfall: replica
+    spans offset onto the router's timeline (wall-clock aligned), the
+    replica root re-rooted as `replica:request`, and the attribution
+    table drawing from both hops without counting roots as buckets."""
+    tlm = _load_tlm()
+    tracer = spans.Tracer(sample=1.0)
+    rtr = tracer.start("pair")
+    t = rtr.t0
+    time.sleep(0.005)                   # the forward leaves the router...
+    rep = tracer.start("pair", rtr.trace_id)   # ...and lands on a replica
+    tr0 = rep.t0
+    rep.span("admit", tr0, tr0 + 0.001)
+    rep.span("execute", tr0 + 0.001, tr0 + 0.010)
+    rep_rec = rep.finish()
+    rtr.span("route", t, t + 0.0005, replica=0)
+    rtr.span("forward", t + 0.0005, t + 0.020, replica=0)
+    rtr_rec = rtr.finish()
+
+    (tmp_path / "events.jsonl").write_text(json.dumps(rtr_rec) + "\n")
+    (tmp_path / "replica-0").mkdir()
+    (tmp_path / "replica-0" / "events.jsonl").write_text(
+        json.dumps(rep_rec) + "\n")
+
+    records = tlm.load_records(tmp_path)    # fleet run dir layout
+    traces = tlm.trace_records(records)
+    assert len(traces) == 1                 # one request, joined
+    joined = traces[0]
+    assert joined["hops"] == 2
+    names = [s["name"] for s in joined["spans"]]
+    assert "route" in names and "forward" in names
+    assert "admit" in names and "replica:request" in names
+    rep_root = next(s for s in joined["spans"]
+                    if s["name"] == "replica:request")
+    assert rep_root["start_ms"] >= 3.0      # offset by the hop gap
+    rendered = "\n".join(tlm.render_trace(joined))
+    assert "forward" in rendered and "replica:request" in rendered
+
+    att = "\n".join(tlm.attribution_lines(records))
+    assert "forward" in att and "admit" in att
+    assert "replica:request" not in att     # roots are covers, not buckets
+
+    # identical duplicates (events.jsonl + flightrec) still collapse to
+    # a single un-joined record
+    dup = [rtr_rec, dict(rtr_rec)]
+    only = tlm.trace_records(dup)
+    assert len(only) == 1 and "hops" not in only[0]
+
+
 def test_tlm_trace_reads_run_dir_with_flightrec(tmp_path):
     tlm = _load_tlm()
     recs = _sample_trace_records()

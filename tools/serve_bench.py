@@ -58,6 +58,22 @@ histograms).  With ``--smoke`` it asserts zero recompiles under the
 watchdog, non-zero fnet cache hits, mean stream-step width > 1 across
 lockstep sessions, and zero lock-order violations (the validator is
 self-armed) — the CI streaming gate.
+
+``--fleet`` is the multi-replica arm (raft_tpu/fleet): N ``-m serve``
+subprocesses pinned to disjoint CPU slices behind the in-process
+admission router, benched THROUGH the router.  Three acts: (1) capacity
+scaling — the same closed-loop load against one routable replica, then
+against the full fleet (same pinning, so the comparison is capacity,
+not core-grabbing); (2) with ``--chaos``, the replica-kill drill — live
+streaming sessions, SIGKILL the pinned replica mid-sequence, and every
+session must heal transparently (zero non-200 advances, migrated flow
+equal to pairwise within the repo's cross-executable tolerance,
+recovery inside one health-poll window, fleet respawned back to
+desired size); (3) a rolling weight hot-swap under live load — zero
+dropped requests, requests served DURING the roll, zero compile-cache
+misses on every replica (params are runtime args, same avals -> same
+executables).  ``--smoke`` gates all of it for CI; the full run
+additionally gates aggregate scaling >= 1.7x one replica.
 """
 
 from __future__ import annotations
@@ -809,6 +825,547 @@ def run_video_bench(args, host, port, server, config) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# fleet arm (--fleet): subprocess replicas behind the admission router
+# ---------------------------------------------------------------------------
+
+_OCTET_HEADERS = {"Content-Type": "application/octet-stream",
+                  "Accept": "application/octet-stream"}
+
+# the repo's cross-executable equality bar (tests/test_chaos.py,
+# tests/test_fleet.py): a migrated advance and a pairwise /v1/flow run
+# DIFFERENT XLA executables over the same weights, so bitwise equality
+# is not on the table — this tolerance is
+_MIGRATE_RTOL, _MIGRATE_ATOL = 1e-4, 1e-2
+
+
+def _stream_rpc(conn, host, port, arrays):
+    """One /v1/stream npz round-trip on a keep-alive conn.  Returns
+    (status, payload_arrays, replica_idx, conn) — the conn is rebuilt
+    after a transport failure so the caller can keep going."""
+    try:
+        conn.request("POST", "/v1/stream", body=_npz(**arrays),
+                     headers=_OCTET_HEADERS)
+        resp = conn.getresponse()
+        payload = resp.read()
+        st = resp.status
+        rep = resp.getheader("X-Raft-Replica")
+    except Exception:
+        conn.close()
+        return -1, {}, None, http.client.HTTPConnection(host, port,
+                                                        timeout=60)
+    out = {}
+    if st == 200 and payload:
+        with np.load(io.BytesIO(payload)) as z:
+            out = {k: z[k] for k in z.files}
+    return st, out, (int(rep) if rep is not None else None), conn
+
+
+def _flow_rpc(host, port, im1, im2):
+    """One routed /v1/flow pair; returns (status, flow|None)."""
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    try:
+        conn.request("POST", "/v1/flow", body=_npz(image1=im1, image2=im2),
+                     headers=_OCTET_HEADERS)
+        resp = conn.getresponse()
+        payload = resp.read()
+        st = resp.status
+    except Exception:
+        return -1, None
+    finally:
+        conn.close()
+    if st != 200:
+        return st, None
+    with np.load(io.BytesIO(payload)) as z:
+        return st, np.asarray(z["flow"])
+
+
+def _stream_replay_flow(host, port, prev, cur):
+    """The migration recipe replayed on a FRESH routed session:
+    open(prev) -> advance(cur) -> close.  This runs the exact
+    executables a healed session's first advance runs, so equality at
+    the repo bar is config-independent — unlike the pairwise
+    comparison, whose different executable diverges measurably once
+    enough recurrent iterations amplify float noise (random weights,
+    bilinear correlation lookups)."""
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    st, out, _, conn = _stream_rpc(conn, host, port, {"image": prev})
+    if st != 200:
+        conn.close()
+        return st, None
+    sid = str(out["session"])
+    st, out, _, conn = _stream_rpc(
+        conn, host, port,
+        {"op": np.asarray("advance"), "session": np.asarray(sid),
+         "image": cur})
+    flow = (np.asarray(out["flow"])
+            if st == 200 and "flow" in out else None)
+    _stream_rpc(conn, host, port,
+                {"op": np.asarray("close"), "session": np.asarray(sid)})
+    conn.close()
+    return st, flow
+
+
+def _replica_prom(rep):
+    """Scrape one replica's own /metrics (the per-replica families —
+    compile misses, lock validator — live there, not on the router)."""
+    import urllib.request
+    try:
+        with urllib.request.urlopen(rep.url + "/metrics", timeout=10) as r:
+            return parse_prom(r.read().decode())
+    except Exception:
+        return {}
+
+
+def _fleet_chaos_drill(args, host, port, manager, fcfg):
+    """Act two: SIGKILL the replica that live streaming sessions are
+    pinned to, mid-sequence.  The sessions must heal without the client
+    noticing anything but the ``migrated`` flag: every advance answers
+    200 (the router replays the host-side prev-frame on a survivor),
+    the migrated flow equals the routed pairwise flow for the same
+    frames, and the fleet respawns back to its desired size.  Returns
+    (record, problems)."""
+    h, w = args.size
+    S = args.sessions or (2 if args.smoke else 4)
+    F = min(args.frames, 4) if args.smoke else args.frames
+    seqs = [make_session_frames(h, w, F, seed=100 + i, shift=args.shift)
+            for i in range(S)]
+    conns = [http.client.HTTPConnection(host, port, timeout=60)
+             for _ in range(S)]
+    problems = []
+    sids, pinned = [], []
+    for i in range(S):
+        st, out, rep, conns[i] = _stream_rpc(conns[i], host, port,
+                                             {"image": seqs[i][0]})
+        if st != 200:
+            return ({"error": f"session open {i} returned {st}"}, \
+                   [f"chaos drill could not open session {i} ({st})"])
+        sids.append(str(out["session"]))
+        pinned.append(rep)
+
+    statuses = {}
+    def advance(i, t):
+        st, out, rep, conns[i] = _stream_rpc(
+            conns[i], host, port,
+            {"op": np.asarray("advance"), "session": np.asarray(sids[i]),
+             "image": seqs[i][t]})
+        statuses[str(st)] = statuses.get(str(st), 0) + 1
+        return st, out, rep
+
+    for i in range(S):                     # frame 1: everyone pre-kill
+        advance(i, 1)
+
+    victim = pinned[0]
+    t_kill = time.monotonic()
+    manager.kill(victim)
+    print(f"[bench] chaos: killed replica {victim} with {S} live "
+          f"session(s), {pinned.count(victim)} pinned to it")
+
+    migrated_to = {}
+    recovery_s = None
+    replay_match, replay_diff = None, None
+    pair_match, pair_diff = None, None
+    for t in range(2, F):
+        for i in range(S):
+            st, out, rep = advance(i, t)
+            if st != 200 or not bool(out.get("migrated")) \
+                    or i in migrated_to:
+                continue
+            migrated_to[i] = rep
+            if recovery_s is None:
+                recovery_s = time.monotonic() - t_kill
+            if replay_match is None and "flow" in out:
+                mflow = np.asarray(out["flow"])
+                # transparency bar #1 (config-independent): the healed
+                # session's flow vs a fresh routed session replaying
+                # the SAME frames — migration-by-replay made literal
+                rst, rflow = _stream_replay_flow(
+                    host, port, seqs[i][t - 1], seqs[i][t])
+                if rst == 200 and rflow is not None:
+                    replay_diff = float(np.max(np.abs(mflow - rflow)))
+                    replay_match = bool(np.allclose(
+                        mflow, rflow,
+                        rtol=_MIGRATE_RTOL, atol=_MIGRATE_ATOL))
+                # transparency bar #2: vs the routed pairwise answer —
+                # a DIFFERENT executable, so the bar only holds where
+                # the repo established it (the smoke config's few
+                # iterations); always recorded, gated under --smoke
+                fst, pflow = _flow_rpc(host, port, seqs[i][t - 1],
+                                       seqs[i][t])
+                if fst == 200:
+                    pair_diff = float(np.max(np.abs(mflow - pflow)))
+                    pair_match = bool(np.allclose(
+                        mflow, pflow,
+                        rtol=_MIGRATE_RTOL, atol=_MIGRATE_ATOL))
+    for i in range(S):
+        _stream_rpc(conns[i], host, port,
+                    {"op": np.asarray("close"),
+                     "session": np.asarray(sids[i])})
+        conns[i].close()
+
+    # heal: restart_dead respawns a replacement; wait for the fleet to
+    # converge back to desired (also keeps teardown from racing a
+    # replica that is mid-warmup)
+    healed_s = None
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < fcfg.spawn_timeout_s:
+        if manager.ready_count() >= manager.desired:
+            healed_s = round(time.monotonic() - t0, 1)
+            break
+        time.sleep(0.5)
+
+    failures = sum(v for k, v in statuses.items() if k != "200")
+    if failures:
+        problems.append(f"{failures} innocent stream failure(s) during "
+                        f"the replica kill (statuses {statuses})")
+    if not migrated_to:
+        problems.append("no session migrated after the kill")
+    if replay_match is False:
+        problems.append(f"migrated flow != fresh-session replay of the "
+                        f"same frames (max abs diff {replay_diff:.4g})")
+    elif migrated_to and replay_match is None:
+        problems.append("migrated advance carried no flow to compare")
+    if args.smoke and pair_match is False:
+        problems.append(f"migrated flow != routed pairwise flow "
+                        f"(max abs diff {pair_diff:.4g})")
+    window_s = fcfg.health_poll_s + fcfg.health_timeout_s
+    if recovery_s is not None and recovery_s > window_s:
+        problems.append(f"first healed advance took {recovery_s:.1f}s "
+                        f"(> one poll window {window_s:.1f}s)")
+    if healed_s is None:
+        problems.append("fleet never respawned back to desired size")
+    rec = {
+        "sessions": S, "frames": F, "victim_replica": victim,
+        "pinned_to_victim": pinned.count(victim),
+        "migrated_sessions": len(migrated_to),
+        "advance_statuses": statuses,
+        "recovery_s": round(recovery_s, 3) if recovery_s else None,
+        "poll_window_s": window_s,
+        "flow_matches_replay": replay_match,
+        "max_replay_diff": replay_diff,
+        "flow_matches_pairwise": pair_match,
+        "max_pairwise_diff": pair_diff,
+        "respawned_in_s": healed_s,
+        "restarts": manager.restarts,
+    }
+    return rec, problems
+
+
+def _fleet_hot_swap(args, host, port, manager, updater, params, out_dir,
+                    flow_body):
+    """Act three: roll new weights across the fleet while closed-loop
+    load runs through the router.  Zero non-200s, requests served
+    DURING the roll window, zero compile-cache misses on any replica
+    (same tree/shape/dtype -> the executables never change).  Returns
+    (record, problems)."""
+    import jax
+
+    from raft_tpu.convert.weights import save_params_npz
+
+    params2 = jax.tree_util.tree_map(
+        lambda a: (np.asarray(a) * 1.001).astype(np.asarray(a).dtype),
+        params)
+    weights_v2 = os.path.join(out_dir, "weights_v2.npz")
+    save_params_npz(params2, weights_v2)
+    with open(weights_v2, "rb") as f:
+        body2 = f.read()
+
+    before = {r.idx: _replica_prom(r) for r in manager.routable()}
+    stop = threading.Event()
+    loads, lock = [], threading.Lock()
+
+    def loader():
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        while not stop.is_set():
+            try:
+                conn.request("POST", "/v1/flow", body=flow_body,
+                             headers=_OCTET_HEADERS)
+                resp = conn.getresponse()
+                resp.read()
+                st = resp.status
+            except Exception:
+                conn.close()
+                conn = http.client.HTTPConnection(host, port, timeout=60)
+                st = -1
+            with lock:
+                loads.append((st, time.monotonic()))
+        conn.close()
+
+    workers = [threading.Thread(target=loader)
+               for _ in range(max(2, args.clients // 2))]
+    for t in workers:
+        t.start()
+    time.sleep(0.5)                  # load established before the roll
+    t_roll0 = time.monotonic()
+    results = updater.roll(body2, tag="bench-v2")
+    t_roll1 = time.monotonic()
+    time.sleep(0.5)                  # and still flowing after it
+    stop.set()
+    for t in workers:
+        t.join()
+
+    after = {r.idx: _replica_prom(r) for r in manager.routable()}
+    miss = "raft_serving_compile_cache_misses_total"
+    miss_delta = {str(i): int(after[i].get(miss, 0)
+                              - before[i].get(miss, 0))
+                  for i in after if i in before}
+    with lock:
+        snapshot = list(loads)
+    bad = [st for st, _ in snapshot if st != 200]
+    served_during = sum(1 for st, t in snapshot
+                        if st == 200 and t_roll0 <= t <= t_roll1)
+    roll_statuses = [r["status"] for r in results]
+
+    problems = []
+    if bad:
+        problems.append(f"{len(bad)} dropped/failed request(s) during "
+                        f"the hot-swap roll")
+    if not results or any(s != "reloaded" for s in roll_statuses):
+        problems.append(f"hot-swap roll did not reload every replica: "
+                        f"{roll_statuses}")
+    if served_during == 0:
+        problems.append("no request served during the roll window — "
+                        "zero-downtime unproven")
+    if any(d != 0 for d in miss_delta.values()):
+        problems.append(f"compile-cache misses during the hot-swap "
+                        f"(per replica: {miss_delta})")
+    rec = {
+        "rolled": roll_statuses,
+        "weights": [r.get("weights") for r in results],
+        "roll_s": round(t_roll1 - t_roll0, 3),
+        "load_requests": len(snapshot),
+        "load_failures": len(bad),
+        "served_during_roll": served_during,
+        "compile_miss_delta": miss_delta,
+    }
+    return rec, problems
+
+
+def run_fleet_bench(args) -> int:
+    """--fleet: spawn the real subprocess fleet behind the in-process
+    admission router and bench through the front door.
+
+    Same-box scaling is only meaningful with disjoint CPU slices, so
+    replicas are always pinned (round-robin cores, manager policy) and
+    the one-replica baseline keeps ITS slice — capacity scaling, not
+    one process grabbing every core."""
+    import tempfile
+
+    # every fleet bench doubles as a race hunt + recompile watch: arm
+    # both validators BEFORE any fleet lock / replica is constructed
+    # (the router's locks live in this process; the children inherit
+    # the environment)
+    os.environ.setdefault("RAFT_TPU_LOCK_WATCH", "1")
+    os.environ.setdefault("RAFT_TPU_WATCHDOGS", "1")
+
+    from raft_tpu.config import RAFTConfig, init_rng
+    from raft_tpu.convert.weights import save_params_npz
+    from raft_tpu.fleet import (FleetConfig, FleetRouter, ReplicaManager,
+                                RollingUpdater)
+    from raft_tpu.models import init_raft
+    from raft_tpu.telemetry.watchdogs import lock_validator, \
+        lock_watch_enabled
+
+    h, w = args.size
+    bucket_spec = args.buckets or f"{-(-h // 8) * 8}x{-(-w // 8) * 8}"
+    config = (RAFTConfig.small_model(iters=args.iters)
+              if args.small else RAFTConfig.full(iters=args.iters or 12))
+    if args.load:
+        from raft_tpu.convert import load_checkpoint_auto
+        params = load_checkpoint_auto(args.load)
+    else:
+        params = init_raft(init_rng(), config)
+
+    out_dir = tempfile.mkdtemp(prefix="raft_fleet_bench_")
+    # ONE set of weights for every replica — migrated flow == pairwise
+    # depends on it (fleet/launch.py makes the same guarantee)
+    weights_v1 = os.path.join(out_dir, "weights_v1.npz")
+    save_params_npz(params, weights_v1)
+
+    sessions = max((args.sessions or 4) + 2, 4)
+    base = ["--load", weights_v1, "--buckets", bucket_spec,
+            "--max-batch", str(args.max_batch),
+            "--max-wait-ms", str(args.max_wait_ms),
+            "--queue-depth", str(args.queue_depth),
+            "--deadline-ms", str(args.deadline_ms),
+            "--max-sessions", str(max(args.max_sessions, sessions))]
+    if args.small:
+        base.append("--small")
+    if args.iters:
+        base += ["--iters", str(args.iters)]
+    if args.iters_policy:
+        base += ["--iters-policy", args.iters_policy]
+    if args.trace_sample is not None:
+        base += ["--trace-sample", str(args.trace_sample)]
+    if args.cpu:
+        base.append("--cpu")
+
+    fcfg = FleetConfig(
+        replicas=args.replicas, min_replicas=1,
+        max_replicas=args.replicas, host="127.0.0.1", port=0,
+        health_poll_s=1.0, pin_cpus=True,
+        trace_sample=(1.0 if args.trace_sample is None
+                      else args.trace_sample))
+    # a run log in the bench's out_dir: the fleet lifecycle (spawns,
+    # kills, migrations, hot-swaps) lands in events.jsonl next to the
+    # replicas' own logs, so `tlm summary <dir>` tells the drill's story
+    from raft_tpu.telemetry import events as tlm_events
+    run_log = tlm_events.start_run(out_dir, mode="serve_bench_fleet",
+                                   config=config)
+    tlm_events.set_current(run_log)
+    manager = ReplicaManager(fcfg, out_dir, base_args=base,
+                             run_log=run_log)
+    router = FleetRouter(fcfg, manager, out_dir=out_dir, verbose=False,
+                         run_log=run_log)
+    updater = RollingUpdater(manager, metrics=router.metrics,
+                             run_log=run_log)
+    router.updater = updater
+
+    print(f"[bench] spawning fleet of {args.replicas} (pinned over "
+          f"{os.cpu_count()} cores, staggered warmup)...")
+    t0 = time.monotonic()
+    manager.start()
+    router.start()
+    host, port = fcfg.host, router.port
+    print(f"[bench] fleet ready in {time.monotonic() - t0:.1f}s  "
+          f"router={router.url}  buckets={bucket_spec}")
+
+    rng = np.random.RandomState(0)
+    im1 = rng.rand(h, w, 3).astype(np.float32)
+    im2 = np.clip(im1 + rng.randn(h, w, 3).astype(np.float32) * 0.05,
+                  0, 1)
+    body = _npz(image1=im1, image2=im2)
+
+    problems = []
+    chaos_rec = swap_rec = None
+    try:
+        # primer: touch every replica, establish router keep-alives
+        run_closed(host, port, body, min(args.clients, 4),
+                   max(2 * args.replicas, 4))
+
+        # -- act 1: capacity scaling (same load, same pinning) -------------
+        reps = sorted(manager.routable(), key=lambda r: r.idx)
+        for r in reps[1:]:
+            r.updating = True        # router skips them; nothing drains
+        res_one, el_one = run_closed(host, port, body, args.clients,
+                                     args.requests)
+        for r in reps[1:]:
+            r.updating = False
+        res_fleet, el_fleet = run_closed(host, port, body, args.clients,
+                                         args.requests)
+        ok_one = sum(1 for st, _ in res_one if st == 200)
+        ok_fleet = sum(1 for st, _ in res_fleet if st == 200)
+        pps_one = round(ok_one / el_one, 3) if el_one else 0.0
+        pps_fleet = round(ok_fleet / el_fleet, 3) if el_fleet else 0.0
+        ratio = round(pps_fleet / pps_one, 3) if pps_one else None
+        scaling_failures = (len(res_one) - ok_one
+                            + len(res_fleet) - ok_fleet)
+        if scaling_failures:
+            problems.append(f"{scaling_failures} non-200(s) in the "
+                            f"scaling phases")
+        lat = sorted(l for st, l in res_fleet if st == 200)
+        print(f"[bench] scaling: 1 replica {pps_one} pairs/s, "
+              f"{args.replicas} replicas {pps_fleet} pairs/s "
+              f"(x{ratio})")
+
+        # -- act 2: replica-kill drill (--chaos) ---------------------------
+        if args.chaos:
+            chaos_rec, chaos_problems = _fleet_chaos_drill(
+                args, host, port, manager, fcfg)
+            problems.extend(chaos_problems)
+
+        # -- act 3: rolling hot-swap under load ----------------------------
+        swap_rec, swap_problems = _fleet_hot_swap(
+            args, host, port, manager, updater, params, out_dir, body)
+        problems.extend(swap_problems)
+
+        # -- the fleet's own view ------------------------------------------
+        router_prom = scrape(host, port)
+        replica_prom = {r.idx: _replica_prom(r)
+                        for r in manager.routable()}
+    finally:
+        router.stop()
+        manager.stop()
+
+    for idx, prom in sorted(replica_prom.items()):
+        misses = prom.get("raft_serving_compile_cache_misses_total")
+        if misses:
+            problems.append(f"replica {idx}: {int(misses)} compile "
+                            f"miss(es) after warmup")
+        lockv = prom.get("raft_lock_order_violations_total")
+        if lockv is None:
+            problems.append(f"replica {idx}: lock validator families "
+                            f"missing from /metrics (watch never armed)")
+        elif lockv:
+            problems.append(f"replica {idx}: {int(lockv)} lock-order "
+                            f"violation(s)")
+    if not lock_watch_enabled():
+        problems.append("router lock watch never armed")
+    else:
+        counts = lock_validator().counts()
+        if counts["order_violations"]:
+            problems.append(f"{counts['order_violations']} router "
+                            f"lock-order violation(s)")
+    # the scaling acceptance (full runs; two short smoke phases on a
+    # noisy shared runner are not a capacity measurement).  Capacity
+    # scaling needs at least one core per replica — with fewer, the
+    # pinned slices collapse onto the same silicon and the ratio
+    # measures contention, not the router
+    cores = os.cpu_count() or 1
+    scaling_gated = (not args.smoke and args.replicas >= 2
+                     and cores >= args.replicas)
+    if scaling_gated and ratio is not None and ratio < 1.7:
+        problems.append(f"fleet-of-{args.replicas} scaled only "
+                        f"x{ratio} over one replica (< 1.7)")
+    elif not args.smoke and args.replicas >= 2 and not scaling_gated:
+        print(f"[bench] note: {cores} core(s) < {args.replicas} "
+              f"replicas — capacity scaling not measurable on this "
+              f"host; ratio x{ratio} recorded, not gated")
+
+    pct = (lambda q: float(np.percentile(lat, q)) * 1000) if lat \
+        else (lambda q: float("nan"))
+    rec = {
+        "bench": "serving_fleet", "replicas": args.replicas,
+        "run_dir": out_dir,
+        "image_hw": [h, w], "clients": args.clients,
+        "requests_per_phase": args.requests,
+        "pinned_cpus": True, "host_cores": os.cpu_count(),
+        "scaling": {"one_replica_pairs_per_sec": pps_one,
+                    "fleet_pairs_per_sec": pps_fleet, "ratio": ratio,
+                    "gated": scaling_gated},
+        "latency_ms": {"p50": round(pct(50), 2),
+                       "p95": round(pct(95), 2)},
+        "router": {
+            "migrations": int(router_prom.get(
+                "raft_fleet_migrations_total", 0)),
+            "hot_swaps": int(router_prom.get(
+                "raft_fleet_hot_swaps_total", 0)),
+            "retries": int(router_prom.get(
+                "raft_fleet_retries_total", 0)),
+            "replica_restarts": manager.restarts,
+        },
+    }
+    if chaos_rec is not None:
+        rec["chaos"] = chaos_rec
+    if swap_rec is not None:
+        rec["hot_swap"] = swap_rec
+    from raft_tpu.telemetry import run_manifest
+    rec["manifest"] = run_manifest(config=config, mode="serve_bench_fleet")
+    print(json.dumps(rec, indent=2))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"[bench] appended to {args.out}")
+
+    if problems:
+        print("[bench] " + ("SMOKE FAIL: " if args.smoke
+                            else "FLEET FAIL: ") + "; ".join(problems))
+        return 1
+    if args.smoke:
+        print("[bench] SMOKE PASS")
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description="serving load generator")
     p.add_argument("--url", default=None,
@@ -879,11 +1436,33 @@ def main() -> int:
                         "'seed=11,engine_error=0.06,nan=0.06,kill=0.2'), "
                         "then after the storm disarm and assert recovery "
                         "— failures all attributable, no hangs, restarts "
-                        "in metrics, healthz back to ok, zero recompiles")
+                        "in metrics, healthz back to ok, zero recompiles. "
+                        "With --fleet the SPEC is ignored: the drill is "
+                        "a SIGKILL of the replica live sessions are "
+                        "pinned to (e.g. '--chaos kill')")
+    p.add_argument("--fleet", action="store_true",
+                   help="multi-replica arm: spawn --replicas serve "
+                        "subprocesses (disjoint CPU pinning, shared "
+                        "weights) behind the raft_tpu/fleet admission "
+                        "router and bench THROUGH the router — capacity "
+                        "scaling vs one replica, a rolling weight "
+                        "hot-swap under load, and with --chaos the "
+                        "replica-kill drill (sessions heal, migrated "
+                        "flow == pairwise).  --smoke gates zero "
+                        "recompiles / zero lock violations / "
+                        "sessions-survive-kill / served-during-roll")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="fleet arm: replica count (the scaling ratio is "
+                        "measured against a one-replica phase of the "
+                        "same fleet, same pinning)")
     args = p.parse_args()
 
     if args.chaos and (args.url or args.video):
         print("ERROR: --chaos drives the in-process pairwise drill "
+              "(no --url / --video)")
+        return 2
+    if args.fleet and (args.url or args.video):
+        print("ERROR: --fleet spawns its own subprocess fleet "
               "(no --url / --video)")
         return 2
 
@@ -916,6 +1495,9 @@ def main() -> int:
         os.environ["RAFT_TPU_WATCHDOGS"] = "1"
     if args.cpu:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.fleet:
+        return run_fleet_bench(args)
 
     h, w = args.size
     rng = np.random.RandomState(0)

@@ -27,7 +27,12 @@ holding ``{"event": "trace", ...}`` records — a serve run's
 ``events.jsonl`` or a flight-recorder dump (``flightrec.jsonl``,
 ``GET /debug/traces`` saved to a file): without an id it lists the traces
 (slowest / non-ok first); with one (a prefix is enough) it renders the
-span tree as a waterfall.
+span tree as a waterfall.  Pointed at a FLEET run dir (router log at the
+top, ``replica-N/`` subdirs below), records sharing a trace id — the
+router's route/forward/retry/migrate view and the replica's
+admit/queue/execute view of the same request, joined by the propagated
+``X-Raft-Trace-Id`` — merge into one cross-process waterfall, aligned
+on the wall-clock stamps both sides record.
 
 Pure stdlib and importable — no jax required, so it runs in the lint-tier
 CI job and on a laptop without the training environment.
@@ -54,10 +59,16 @@ def load_records(path) -> List[dict]:
         # a run output dir (--out): merge the event log with the training
         # metrics stream(s) one level down — and any flight-recorder dump
         # (serve runs) — so one `tlm summary <out>` sees everything
+        # one level down also covers a fleet run dir: the router's log at
+        # the top, each replica's events.jsonl/flightrec.jsonl in its
+        # replica-N/ subdir — `tlm summary <fleet-out>` sees the whole
+        # fleet, and `tlm trace` can join router + replica spans
         streams = [q for q in
                    [p / "events.jsonl", p / "metrics.jsonl",
                     p / "flightrec.jsonl"]
-                   + sorted(p.glob("*/metrics.jsonl")) if q.exists()]
+                   + sorted(p.glob("*/events.jsonl"))
+                   + sorted(p.glob("*/metrics.jsonl"))
+                   + sorted(p.glob("*/flightrec.jsonl")) if q.exists()]
         if not streams:
             raise FileNotFoundError(
                 f"{path}: no events.jsonl or */metrics.jsonl inside")
@@ -151,6 +162,24 @@ def summary_lines(path) -> List[str]:
     if kinds.get("fault_injected"):
         out.append(f"  chaos: {kinds['fault_injected']} fault(s) injected "
                    f"(--chaos / --chaos-train drill)")
+    # fleet-plane events (OBSERVABILITY.md "Fleet"): replica lifecycle,
+    # session migrations, hot-swaps — the one-line health of a fleet run
+    if any(k.startswith("fleet_") for k in kinds):
+        parts = [f"{kinds.get('fleet_replica_ready', 0)} replica "
+                 f"spawn(s)"]
+        deaths = kinds.get("fleet_replica_dead", 0)
+        if deaths:
+            parts.append(
+                f"{deaths} death(s) "
+                f"({kinds.get('fleet_replica_restarting', 0)} respawned)")
+        if kinds.get("fleet_session_migrated"):
+            parts.append(f"{kinds['fleet_session_migrated']} session "
+                         f"migration(s)")
+        if kinds.get("fleet_hot_swap"):
+            parts.append(f"{kinds['fleet_hot_swap']} weight hot-swap(s)")
+        if kinds.get("fleet_scaled"):
+            parts.append(f"{kinds['fleet_scaled']} scale event(s)")
+        out.append("  fleet: " + ", ".join(parts))
     steps = _step_records(records)
     if steps:
         first, last = steps[0], steps[-1]
@@ -222,20 +251,62 @@ def summary_lines(path) -> List[str]:
 
 # ------------------------------------------------------- request traces --
 
-SPAN_ORDER = ("admit", "queue_wait", "batch_form", "pad", "execute",
+SPAN_ORDER = ("route", "forward", "retry", "migrate",
+              "admit", "queue_wait", "batch_form", "pad", "execute",
               "execute_dispatch", "execute_block", "respond")
+
+
+def _join_traces(recs: List[dict]) -> dict:
+    """Merge several trace records sharing one trace id into a single
+    waterfall.  A fleet request produces one record per hop — the router
+    (route/forward/retry/migrate spans) and the replica it forwarded to
+    (admit/queue_wait/execute/...) — joined by the propagated
+    ``X-Raft-Trace-Id``.  Hops are aligned on the wall-clock finish
+    stamp each record carries (``t`` minus its duration; same-host
+    clocks, so good to well under a millisecond — enough to place the
+    replica's spans inside the router's forward window).  Exact
+    duplicates (events.jsonl + flightrec carry the same record) collapse
+    first, keyed by the root span id."""
+    uniq: dict = {}
+    for r in recs:
+        root = r["spans"][0].get("span") if r.get("spans") else id(r)
+        uniq.setdefault(root, r)
+
+    def t0_wall(r):
+        return (r.get("t") or 0.0) - (r.get("dur_ms") or 0.0) / 1000.0
+
+    hops = sorted(uniq.values(), key=t0_wall)
+    if len(hops) == 1:
+        return hops[0]
+    base = hops[0]
+    base_t0 = t0_wall(base)
+    spans = [dict(s) for s in base["spans"]]
+    for hop in hops[1:]:
+        off_ms = (t0_wall(hop) - base_t0) * 1000.0
+        for s in hop["spans"]:
+            s2 = dict(s)
+            s2["start_ms"] = round(s.get("start_ms", 0.0) + off_ms, 3)
+            if s2.get("name") == "request":
+                s2["name"] = "replica:request"
+            spans.append(s2)
+    joined = dict(base, spans=spans)
+    joined["hops"] = len(hops)
+    return joined
 
 
 def trace_records(records: List[dict]) -> List[dict]:
     """The request-trace records in a stream (events.jsonl `trace` events
-    and flight-recorder dumps share one shape).  Deduplicated by trace id:
-    a default serve run writes each trace to BOTH events.jsonl and the
-    flightrec dump, and a run-dir load merges the two."""
-    out: dict = {}
+    and flight-recorder dumps share one shape), one record per trace id:
+    duplicates (a default serve run writes each trace to BOTH
+    events.jsonl and the flightrec dump) collapse, and multi-hop fleet
+    traces (router + replica views of one request) join into a single
+    waterfall."""
+    by_id: dict = {}
     for r in records:
         if r.get("event") == "trace" and isinstance(r.get("spans"), list):
-            out[r.get("trace_id") or id(r)] = r
-    return list(out.values())
+            by_id.setdefault(r.get("trace_id") or id(r), []).append(r)
+    return [rs[0] if len(rs) == 1 else _join_traces(rs)
+            for rs in by_id.values()]
 
 
 def _pctl(sorted_vals: List[float], q: float) -> float:
@@ -258,7 +329,9 @@ def attribution_lines(records: List[dict]) -> List[str]:
         e2e.append(float(rec.get("dur_ms") or 0.0))
         sums: dict = {}
         for s in rec["spans"]:
-            if s.get("name") == "request":
+            # roots, including a joined hop's re-rooted "replica:request",
+            # are e2e covers, not attribution buckets
+            if str(s.get("name", "")).endswith("request"):
                 continue
             sums[s["name"]] = sums.get(s["name"], 0.0) + s.get("dur_ms", 0.0)
         for k, v in sums.items():
@@ -299,7 +372,8 @@ def trace_list_lines(records: List[dict]) -> List[str]:
                    f"[{r.get('kind', '?'):<6}] "
                    f"{r.get('status', '?'):<9} "
                    f"{r.get('dur_ms', 0.0):9.2f}ms  "
-                   f"{len(r.get('spans', [])):3d} span(s)")
+                   f"{len(r.get('spans', [])):3d} span(s)"
+                   + (f"  joined x{r['hops']}" if r.get("hops") else ""))
     return out
 
 
