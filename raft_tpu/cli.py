@@ -383,6 +383,22 @@ def _build_parser() -> argparse.ArgumentParser:
                         "recompile, and shutdown/SIGTERM (default "
                         "<--out>/flightrec.jsonl; '' disables the file, "
                         "GET /debug/traces still serves the ring)")
+    p.add_argument("--engine-cache-dir", default=None, metavar="DIR",
+                   help="serve mode: AOT executable cache — warmup load-or-"
+                        "compiles serialized executables keyed by (config "
+                        "hash, device kind, jax version); a warm DIR boots "
+                        "the replica with ZERO XLA compiles (serve_fleet "
+                        "shares one DIR across every replica).  Default "
+                        "off: warmup always compiles")
+    p.add_argument("--quant", default=None,
+                   choices=("none", "int8", "bf16w", "int8+bf16w"),
+                   help="serve mode: post-training quantization — 'int8' "
+                        "stores slot-pool fmap/cnet rows as int8 + per-"
+                        "channel f32 scales (dequant on gather; ~3.4x more "
+                        "sessions per HBM byte), 'bf16w' casts the fnet/"
+                        "cnet encoder weights to bf16 for device storage "
+                        "(f32 math), 'int8+bf16w' both.  EPE delta is "
+                        "gated by tools/envelope_check.py")
     # serve_fleet mode (SERVING.md "Fleet"): N serve subprocesses behind
     # one session-affinity router; every serve flag above is forwarded to
     # each replica verbatim
@@ -479,6 +495,8 @@ def _make_config(args):
         overrides["corr_lookup"] = args.corr_lookup
     if getattr(args, "iters_policy", None) is not None:
         overrides["iters_policy"] = args.iters_policy
+    if getattr(args, "quant", None) is not None:
+        overrides["quant"] = args.quant
     if args.iters is not None:
         overrides["iters"] = args.iters
     if args.small:

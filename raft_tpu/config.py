@@ -193,6 +193,34 @@ class RAFTConfig:
     # recompute at more VMEM).  Sweep: tools/tune_pallas.py --kernel gru;
     # hardware numbers pending (TUNING.md round 6).
     gru_block_rows: int = 8
+    # Post-training quantization of the serving plane (SERVING.md "Cold
+    # start & cache"): 'int8' stores the streaming SlotPool's fmap/cnet
+    # rows as int8 with a per-channel f32 scale (dequant-on-gather inside
+    # the sbatch step, quantize-on-scatter inside scommit — the flow seed
+    # row stays f32); 'bf16w' casts the fnet/cnet ENCODER weights to
+    # bfloat16 at load (halves encoder param HBM; the update block stays
+    # f32); 'int8+bf16w' composes both.  Quantization changes the pool
+    # buffer pytree, so it is part of the engine's compile keys and of
+    # lint/budget's config signature — tools/envelope_check.py gates the
+    # EPE delta.  Default 'none' = today's f32 behavior, bit-for-bit.
+    quant: str = "none"
+
+    def __post_init__(self):
+        allowed = ("none", "int8", "bf16w", "int8+bf16w")
+        if self.quant not in allowed:
+            # no-silent-fallback contract, same as parse_iters_policy
+            raise ValueError(f"quant must be one of {allowed}, "
+                             f"got {self.quant!r}")
+
+    @property
+    def quant_slots(self) -> bool:
+        """True when the SlotPool stores int8 fmap/cnet rows."""
+        return "int8" in self.quant
+
+    @property
+    def quant_weights(self) -> bool:
+        """True when the fnet/cnet encoder weights are cast to bf16."""
+        return "bf16w" in self.quant
 
     @property
     def fnet_dim(self) -> int:

@@ -193,6 +193,36 @@ class RollingUpdater:
                 payload = {"error": "unreadable reload response"}
             return e.code, payload
 
+    def _prestage(self, reps) -> Optional[dict]:
+        """Export one replica's warmed executables into the shared AOT
+        cache dir BEFORE the flip loop (POST /admin/cache/prestage), so
+        a replica that dies mid-roll — or is scaled up right after —
+        respawns compile-free.  The serialized executables are keyed by
+        config hash, not weights, so they stay valid across the swap.
+        Best-effort: a fleet without --engine-cache-dir answers 409 and
+        the roll proceeds."""
+        for rep in reps:
+            if not (rep.health or {}).get("engine_cache"):
+                continue            # cacheless (or unprobed) replica
+            req = urllib.request.Request(
+                rep.url + "/admin/cache/prestage", data=b"", method="POST")
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=RELOAD_TIMEOUT_S) as r:
+                    info = json.loads(r.read()).get("cache")
+            except Exception as e:  # noqa: BLE001 — best-effort
+                _log.warning(f"cache prestage on replica {rep.idx} "
+                             f"failed: {e}")
+                return None
+            _log.info(f"replica {rep.idx} prestaged the AOT cache "
+                      f"({info.get('exported')} executable(s)) before "
+                      f"the roll")
+            if self.run_log is not None:
+                self.run_log.event("fleet_cache_prestaged",
+                                   replica=rep.idx, **info)
+            return info
+        return None
+
     def roll(self, body: bytes, tag: Optional[str] = None) -> list:
         """Push ``body`` (a native params npz) to every routable replica
         in index order.  Each replica is soft-drained (``updating`` —
@@ -202,6 +232,8 @@ class RollingUpdater:
         results = []
         with self._roll_lock:
             reps = sorted(self.manager.routable(), key=lambda r: r.idx)
+            if reps:
+                self._prestage(reps)
             aborted = False
             for rep in reps:
                 if aborted:

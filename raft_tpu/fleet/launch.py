@@ -43,6 +43,8 @@ _FORWARDED_FLAGS = (
     ("iters_policy", "--iters-policy"), ("dtype", "--dtype"),
     ("corr_impl", "--corr-impl"), ("corr_lookup", "--corr-lookup"),
     ("gru_impl", "--gru-impl"), ("host", "--host"),
+    ("quant", "--quant"),
+    ("engine_cache_dir", "--engine-cache-dir"),
 )
 _FORWARDED_SWITCHES = (
     ("small", "--small"), ("no_warmup", "--no-warmup"), ("cpu", "--cpu"),
@@ -97,6 +99,14 @@ def build_fleet(args, config, load_params, run_log=None):
         pin_cpus=bool(getattr(args, "pin_cpus", False)),
         trace_sample=getattr(args, "trace_sample", 1.0),
     )
+    if getattr(args, "engine_cache_dir", None) is None:
+        # fleet default: one SHARED AOT executable cache under the fleet
+        # out-dir (serving/aot_cache.py).  Replica 0 compiles + serializes;
+        # every later spawn — scale-up, chaos respawn, rolling update —
+        # deserializes instead of repeating the compile storm, and the
+        # manager skips the stagger once the first replica reports a
+        # fully-warm cache.
+        args.engine_cache_dir = str(out_dir / "engine-cache")
     weights = ensure_weights(args, config, load_params, out_dir)
     manager = ReplicaManager(fconfig, str(out_dir),
                              base_args=replica_args(args, weights),

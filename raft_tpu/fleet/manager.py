@@ -236,13 +236,28 @@ class ReplicaManager:
 
     def start(self) -> None:
         """Bring up the initial fleet (staggered by default) and start
-        the health poll loop."""
-        for _ in range(self.config.replicas):
-            if self.config.stagger:
-                self._spawn_one()
-        if not self.config.stagger:
+        the health poll loop.  Staggering exists to serialize N cold
+        XLA compile storms — so when the FIRST replica reports it
+        warmed entirely from the shared AOT cache (healthz
+        engine_cache: misses == 0), the remaining replicas spawn in
+        parallel: they will deserialize, not compile."""
+        rest = self.config.replicas
+        if self.config.stagger and rest > 0:
+            first = self._spawn_one()
+            rest -= 1
+            if rest > 0 and not self._cache_warm(first):
+                for _ in range(rest):
+                    self._spawn_one()
+                rest = 0
+            elif rest > 0:
+                _log.info(f"replica {first.idx} booted from the AOT cache "
+                          f"(0 compiles): skipping staggered warmup for "
+                          f"the remaining {rest} replica(s)")
+                self._event("fleet_stagger_skipped", warm_idx=first.idx,
+                            parallel=rest)
+        if rest > 0:
             threads = [threading.Thread(target=self._spawn_one, daemon=True)
-                       for _ in range(self.config.replicas)]
+                       for _ in range(rest)]
             for t in threads:
                 t.start()
             for t in threads:
@@ -251,6 +266,16 @@ class ReplicaManager:
                                              daemon=True,
                                              name="raft-fleet-health")
         self._poll_thread.start()
+
+    def _cache_warm(self, rep: Replica) -> bool:
+        """True when ``rep`` reports it warmed entirely from the AOT
+        executable cache (healthz engine_cache: hits > 0, misses == 0)
+        — the signal that later spawns will deserialize, not compile."""
+        if not self._probe(rep):
+            return False
+        ec = (rep.health or {}).get("engine_cache")
+        return (bool(ec) and ec.get("misses") == 0
+                and ec.get("hits", 0) > 0)
 
     def stop(self) -> None:
         """Terminate every replica (SIGTERM = graceful drain; SIGKILL

@@ -324,7 +324,20 @@ def param_specs(config):
 
     from ..config import init_rng
     from ..models.raft import init_raft
-    return jax.eval_shape(lambda k: init_raft(k, config), init_rng(0))
+    specs = jax.eval_shape(lambda k: init_raft(k, config), init_rng(0))
+    if config.quant_weights:
+        # quant='bf16w': the engine stores the fnet/cnet encoder weights
+        # in bf16 (models/raft.cast_encoder_weights) — price them that way
+        import jax.numpy as jnp
+
+        def bf16(s):
+            return (jax.ShapeDtypeStruct(s.shape, jnp.bfloat16)
+                    if s.dtype == jnp.float32 else s)
+        specs = dict(specs)
+        for k in ("fnet", "cnet"):
+            if k in specs:
+                specs[k] = jax.tree.map(bf16, specs[k])
+    return specs
 
 
 def _motion_dim(pspecs, config) -> int:
@@ -348,14 +361,22 @@ def feature_specs(config, pspecs, h: int, w: int, b: int = 1):
 
 def slot_specs(config, pspecs, h: int, w: int, capacity: int):
     """The per-bucket SlotPool buffer specs ([capacity+1, …] — the extra
-    row is the scratch slot), mirroring ``engine._slot_specs``."""
+    row is the scratch slot), mirroring ``engine._slot_specs``: under
+    ``quant='int8'`` the fmap/cnet entries are 2-leaf (int8 vals, f32
+    per-channel scales) pytrees (parity-tested against the engine)."""
     import jax
     import jax.numpy as jnp
     fs, cs = feature_specs(config, pspecs, h, w, 1)
     cap1 = capacity + 1
+    flow = jax.ShapeDtypeStruct((cap1, h // 8, w // 8, 2), jnp.float32)
+    if config.quant_slots:
+        def q(s):
+            return (jax.ShapeDtypeStruct((cap1,) + s.shape[1:], jnp.int8),
+                    jax.ShapeDtypeStruct((cap1, s.shape[-1]), jnp.float32))
+        return (q(fs), q(cs), flow)
     return (jax.ShapeDtypeStruct((cap1,) + fs.shape[1:], fs.dtype),
             jax.ShapeDtypeStruct((cap1,) + cs.shape[1:], cs.dtype),
-            jax.ShapeDtypeStruct((cap1, h // 8, w // 8, 2), jnp.float32))
+            flow)
 
 
 def kind_footprint(config, pspecs, key: Key, capacity: int,
@@ -385,7 +406,7 @@ def kind_footprint(config, pspecs, key: Key, capacity: int,
     idx = jax.ShapeDtypeStruct((b,), jnp.int32)
     mask = jax.ShapeDtypeStruct((b,), jnp.bool_)
     pool = slot_specs(config, pspecs, h, w, capacity)
-    pool_b = sum(bytes_of(s) for s in pool)
+    pool_b = tree_bytes(pool)       # leaf-wise: quant entries are nested
     donated: Sequence = ()
     resident_inputs: Sequence = ()
 
@@ -409,14 +430,15 @@ def kind_footprint(config, pspecs, key: Key, capacity: int,
         resident_inputs = pool
     elif kind == "scommit":
         fs, cs = feature_specs(config, pspecs, h, w, b)
-        out = jax.eval_shape(make_slot_commit_fn(), *pool, idx, fs, cs,
-                             flow, mask)
+        out = jax.eval_shape(make_slot_commit_fn(quant=config.quant_slots),
+                             *pool, idx, fs, cs, flow, mask)
         inputs = (idx, fs, cs, flow, mask)
         resident_inputs = pool
         if donation:
             donated = pool               # outputs alias the donated buffers
     elif kind == "spoison":
-        out = jax.eval_shape(make_slot_poison_fn(), pool[0], idx)
+        out = jax.eval_shape(make_slot_poison_fn(quant=config.quant_slots),
+                             pool[0], idx)
         inputs = (idx,)
         resident_inputs = (pool[0],)
         if donation:
@@ -430,7 +452,7 @@ def kind_footprint(config, pspecs, key: Key, capacity: int,
 
     in_b = sum(bytes_of(s) for s in jax.tree.leaves(list(inputs)))
     out_b = tree_bytes(out)
-    don_b = sum(bytes_of(s) for s in donated)
+    don_b = tree_bytes(list(donated))
     if kind == "szero":
         transient = 0
     else:
@@ -447,6 +469,7 @@ def config_signature(config, sconfig, stream: bool, chaos: bool) -> dict:
     return {
         "small": config.small,
         "compute_dtype": config.compute_dtype,
+        "quant": config.quant,
         "buckets": [list(b) for b in sconfig.buckets],
         "batch_steps": list(sconfig.batch_steps),
         "max_sessions": sconfig.max_sessions,
@@ -496,8 +519,9 @@ def analyze(config, sconfig, device_kind: str = "tpu-v4",
     violations: List[str] = []
     for (bh, bw) in sconfig.buckets:
         pool = slot_specs(rconfig, pspecs, bh, bw, capacity)
-        pool_b = sum(bytes_of(s) for s in pool)
-        row_b = sum(bytes_of(s) // (capacity + 1) for s in pool)
+        pool_b = tree_bytes(pool)
+        row_b = sum(bytes_of(s) // (capacity + 1)
+                    for s in jax.tree.leaves(pool))
         kinds = [kind_footprint(rconfig, pspecs, k, capacity,
                                 donation=donation)
                  for k in keys if (k[1], k[2]) == (bh, bw)]

@@ -14,8 +14,12 @@ boots — these rules keep the code shaped so the analyzer stays TRUE:
   by warmup.  Warmup coverage is the union of the string literals in
   every ``warmup()`` body plus, when warmup consumes the analyzer's
   ``enumerate_warmup_grid``, the literals of that function — so the
-  enumeration refactor doesn't hide coverage from the rule.  A
-  dispatched-but-unwarmed kind is a guaranteed serve-time cold compile.
+  enumeration refactor doesn't hide coverage from the rule.  Since the
+  AOT cache (serving/aot_cache.py) made warming a load-or-compile,
+  ``export_cache()`` bodies count as warmup surfaces too: a kind
+  serialized into the cache is warmed (deserialized) at the next boot.
+  A dispatched-but-unwarmed kind is a guaranteed serve-time cold
+  compile.
 * B3 — device-array allocation (``jnp.zeros`` & co) on a serving hot
   path outside the engine/SlotPool.  Per-request device allocation
   bypasses the budgeted resident set: stage on the host with numpy and
@@ -24,6 +28,13 @@ boots — these rules keep the code shaped so the analyzer stays TRUE:
   The budget model is shared by construction (the Pallas kernels import
   their block plans from it); a local ``VMEM_LIMIT = 16 * 1024 * 1024``
   re-derives what the analyzer can then no longer see.
+* B5 — the serialized engine-cache key schema
+  (``serving/aot_cache.KEY_FIELDS``) drifting out of sync with the key
+  tuples ``lint/budget.enumerate_warmup_grid`` builds.  The manifest of
+  a cache directory pins the field names/order every ``.bin`` filename
+  encodes; a grid-side reorder or new field would silently make every
+  persisted cache stale (or worse, collide) — the two definitions must
+  agree field-for-field.
 """
 
 from __future__ import annotations
@@ -171,7 +182,9 @@ class B2UnwarmedKind(GlobalRule):
         warmups: List[ast.AST] = []
         for ctx in ctxs:
             for fn in ctx.functions:
-                if fn.name != "warmup":
+                # export_cache serializes warmed executables for the AOT
+                # cache: a kind it covers is warmed-on-load at next boot
+                if fn.name not in ("warmup", "export_cache"):
                     continue
                 warmups.append(fn)
                 coverage |= _string_constants(fn)
@@ -293,3 +306,56 @@ class B4HardcodedVmemBudget(Rule):
                         f"import it from raft_tpu.lint.budget "
                         f"(VMEM_BYTES / DEVICE_BUDGETS) so the static "
                         f"analyzer and the code agree on one number")
+
+
+@register
+class B5CacheKeySchemaDrift(GlobalRule):
+    rule_id = "B5"
+    severity = "error"
+    description = ("serialized engine-cache key schema (aot_cache."
+                   "KEY_FIELDS) out of sync with the key tuple "
+                   "lint/budget.enumerate_warmup_grid builds")
+
+    def check_all(self, ctxs: Sequence[FileContext]) -> Iterable[Finding]:
+        # side 1: the persisted schema — KEY_FIELDS = ("kind", ...) in the
+        # cache module (a module-level tuple of string literals)
+        fields = None
+        f_ctx = f_node = None
+        # side 2: the live key — ``key = (kind, h, w, b, policy)`` inside
+        # enumerate_warmup_grid (a tuple of plain names)
+        names = None
+        n_ctx = n_node = None
+        for ctx in ctxs:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id == "KEY_FIELDS" \
+                        and isinstance(node.value, ast.Tuple):
+                    vals = [e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)]
+                    if len(vals) == len(node.value.elts):
+                        fields, f_ctx, f_node = tuple(vals), ctx, node
+            for fn in ctx.functions:
+                if fn.name != "enumerate_warmup_grid":
+                    continue
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Assign) \
+                            and len(node.targets) == 1 \
+                            and isinstance(node.targets[0], ast.Name) \
+                            and node.targets[0].id == "key" \
+                            and isinstance(node.value, ast.Tuple):
+                        names = tuple(
+                            e.id if isinstance(e, ast.Name) else "<expr>"
+                            for e in node.value.elts)
+                        n_ctx, n_node = ctx, node
+        if fields is None or names is None:
+            return      # one side absent from the scan set: no baseline
+        if fields != names:
+            yield self.finding(
+                f_ctx, f_node,
+                f"aot_cache.KEY_FIELDS {fields!r} no longer matches the "
+                f"key tuple enumerate_warmup_grid builds {names!r} "
+                f"({n_ctx.path}:{n_node.lineno}) — every persisted cache "
+                f"manifest pins this schema, so the two definitions must "
+                f"agree name-for-name, in order")

@@ -452,11 +452,58 @@ def _iterate_flow(params, fmap1: jax.Array, fmap2: jax.Array,
 
 
 def _cast_params(params: Dict[str, dict], config: RAFTConfig):
-    if config.compute_dtype != "bfloat16":
+    if config.compute_dtype == "bfloat16":
+        # One cast at the top; correlation and upsampling stay float32.
+        return jax.tree.map(lambda a: a.astype(jnp.bfloat16)
+                            if a.dtype == jnp.float32 else a, params)
+    if config.quant_weights:
+        # quant='bf16w' stores the encoder weights bf16 on device
+        # (cast_encoder_weights); f32 compute up-casts them in-graph so
+        # conv dtypes match — the numerics are exactly "bf16-rounded
+        # weights, f32 math".
+        return jax.tree.map(lambda a: a.astype(jnp.float32)
+                            if a.dtype == jnp.bfloat16 else a, params)
+    return params
+
+
+def cast_encoder_weights(params: Dict[str, dict], config: RAFTConfig):
+    """``quant='bf16w'``: cast the fnet/cnet ENCODER weights to bf16 for
+    device storage — halves the encoder half of param HBM (the update
+    block stays f32).  Applied ONCE at load time by the serving engine;
+    :func:`_cast_params` up-casts in-graph for f32 compute, so serving
+    numerics equal bf16-rounded weights under the configured compute
+    dtype.  No-op for other quant modes."""
+    if not config.quant_weights:
         return params
-    # One cast at the top; correlation and upsampling stay float32.
-    return jax.tree.map(lambda a: a.astype(jnp.bfloat16)
-                        if a.dtype == jnp.float32 else a, params)
+    out = dict(params)
+    for k in ("fnet", "cnet"):
+        if k in out:
+            out[k] = jax.tree.map(lambda a: a.astype(jnp.bfloat16)
+                                  if a.dtype == jnp.float32 else a, out[k])
+    return out
+
+
+def quantize_rows(rows: jax.Array):
+    """Symmetric per-channel int8 quantization of feature rows
+    ``[..., H, W, C]`` -> ``(int8 vals [..., H, W, C], f32 scales
+    [..., C])`` with the absmax over the spatial dims mapped to 127.
+
+    The SlotPool storage format under ``quant='int8'``: encoder outputs
+    (fmap/cnet rows) quantize on scatter (serving/session.py
+    ``make_slot_commit_fn``) and dequantize on gather
+    (:func:`make_stream_batch_step_fn`), shrinking the cached per-session
+    rows ~4x so more sessions fit one chip.  The scale floor keeps an
+    all-zero channel from dividing by zero (it round-trips to exact 0)."""
+    rows = rows.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(rows), axis=(-3, -2))
+    scales = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.round(rows / scales[..., None, None, :])
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scales
+
+
+def dequantize_rows(vals: jax.Array, scales: jax.Array) -> jax.Array:
+    """Inverse of :func:`quantize_rows` (f32 output)."""
+    return vals.astype(jnp.float32) * scales[..., None, None, :]
 
 
 @contract(image="*[B,H,W,3]")
@@ -580,11 +627,23 @@ def make_stream_batch_step_fn(config: RAFTConfig,
     """
     from ..config import adaptive_iters
     adaptive = adaptive_iters(config.iters_policy)
+    quant = config.quant_slots
 
     def fn(params, images, fmap_buf, cnet_buf, flow_buf, slots, active):
         fmap_cur, cnet_cur = encode_frame(params, images, config)
-        fmap_prev = fmap_buf[slots]
-        cnet_prev = cnet_buf[slots]
+        if quant:
+            # quant='int8': fmap_buf/cnet_buf arrive as (int8 vals,
+            # per-channel f32 scales) 2-leaf pytrees — dequant on gather;
+            # the flow seed buffer stays f32
+            fmap_prev = dequantize_rows(fmap_buf[0][slots],
+                                        fmap_buf[1][slots]
+                                        ).astype(fmap_cur.dtype)
+            cnet_prev = dequantize_rows(cnet_buf[0][slots],
+                                        cnet_buf[1][slots]
+                                        ).astype(cnet_cur.dtype)
+        else:
+            fmap_prev = fmap_buf[slots]
+            cnet_prev = cnet_buf[slots]
         flow_init = flow_buf[slots]
         out = forward_from_features(params, fmap_prev, fmap_cur, cnet_prev,
                                     config, iters=iters,

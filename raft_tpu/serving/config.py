@@ -138,6 +138,12 @@ class ServeConfig:
     # recompile watchdog / shutdown; None = /debug/traces only).
     flightrec_traces: int = 64
     flightrec_path: Optional[str] = None
+    # AOT executable cache directory (serving/aot_cache.py): warmup
+    # load-or-compiles serialized executables keyed by (config hash,
+    # device kind, jax version) — a warm directory boots a replica with
+    # ZERO XLA compiles.  The fleet points every replica at one shared
+    # dir.  None disables (warmup always compiles).
+    engine_cache_dir: Optional[str] = None
     # Engine-failure containment (batcher): same-group retries (with
     # backoff) before poisoned-batch bisection splits the blame.
     engine_retries: int = 1

@@ -82,7 +82,7 @@ class InferenceEngine:
 
     def __init__(self, config: RAFTConfig, params, sconfig: ServeConfig,
                  iters: Optional[int] = None, stream: bool = False,
-                 faults=None, pool=None):
+                 faults=None, pool=None, cache=None):
         import jax
 
         # chaos harness (serving/faults.py): injected engine exceptions,
@@ -102,6 +102,15 @@ class InferenceEngine:
         self.iters = iters
         self.iters_policy = config.iters_policy
         self.adaptive = adaptive_iters(config.iters_policy)
+        # aot_cache.EngineCache or None: warmup load-or-compiles through
+        # it, export_cache() populates it for the fleet's shared dir
+        self.cache = cache
+        if config.quant_weights:
+            # quant='bf16w': the encoder weights live on device in bf16
+            # (half the encoder param HBM); reload() applies the same cast
+            # so the swap template stays consistent
+            from ..models.raft import cast_encoder_weights
+            params = cast_encoder_weights(params, config)
         self.params = jax.tree.map(jax.numpy.asarray, params)
         self._mesh = None
         if sconfig.dp_devices > 1:
@@ -143,10 +152,12 @@ class InferenceEngine:
             # a commit updates rows in place (off-CPU; the CPU backend has
             # no donation, so skip it there and keep warmup logs quiet)
             donate = (() if jax.default_backend() == "cpu" else (0, 1, 2))
-            self._scommit_fn = jax.jit(make_slot_commit_fn(),
-                                       donate_argnums=donate)
-            self._spoison_fn = jax.jit(make_slot_poison_fn(),
-                                       donate_argnums=donate[:1])
+            self._scommit_fn = jax.jit(
+                make_slot_commit_fn(quant=config.quant_slots),
+                donate_argnums=donate)
+            self._spoison_fn = jax.jit(
+                make_slot_poison_fn(quant=config.quant_slots),
+                donate_argnums=donate[:1])
             self._feature_specs: Dict[Tuple[int, int, int], tuple] = {}
             self._spec_lock = watched_lock("InferenceEngine._spec_lock")
         # budget None: a cold cache miss compiles while holding the lock
@@ -161,6 +172,7 @@ class InferenceEngine:
         self.weight_version = 1   # bumped by reload(); healthz reports it
         self.weight_tag = None
         self.warmup_seconds = 0.0
+        self.warmup_loaded = 0    # executables served from the AOT cache
 
     # -- compile-cache bookkeeping ---------------------------------------
 
@@ -198,17 +210,43 @@ class InferenceEngine:
     def _slot_specs(self, h: int, w: int):
         """ShapeDtypeStructs of this bucket's pool buffers ([cap+1, …] —
         the extra row is the scratch slot padding rows aim at), derived
-        from the same eval_shape'd feature specs as the stream kinds."""
+        from the same eval_shape'd feature specs as the stream kinds.
+
+        Under ``quant='int8'`` the fmap/cnet entries are 2-leaf pytrees
+        ``((cap+1, …) int8 vals, (cap+1, C) f32 per-channel scales)`` —
+        positional signatures everywhere stay at three buffer args (jit
+        handles pytree args), only the leaves change.  lint/budget's
+        ``slot_specs`` mirrors this shape math exactly (parity-tested)."""
         import jax
         import jax.numpy as jnp
         fs, cs = self._feature_shapes(h, w, 1)
         cap1 = self.pool.capacity + 1
+        flow = jax.ShapeDtypeStruct((cap1, h // 8, w // 8, 2), jnp.float32)
+        if self.config.quant_slots:
+            def q(s):
+                return (jax.ShapeDtypeStruct((cap1,) + s.shape[1:],
+                                             jnp.int8),
+                        jax.ShapeDtypeStruct((cap1, s.shape[-1]),
+                                             jnp.float32))
+            return (q(fs), q(cs), flow)
         return (jax.ShapeDtypeStruct((cap1,) + fs.shape[1:], fs.dtype),
                 jax.ShapeDtypeStruct((cap1,) + cs.shape[1:], cs.dtype),
-                jax.ShapeDtypeStruct((cap1, h // 8, w // 8, 2),
-                                     jnp.float32))
+                flow)
 
     def _compile(self, key: Tuple[str, int, int, int, str]):
+        if self.cache is not None:
+            # serialized executables cannot carry host callbacks — the
+            # NaN sentinel's jax.debug.callback trampoline is a
+            # PyCapsule, which does not pickle — so a cache-attached
+            # engine traces its whole grid sentinel-free.  Uniform by
+            # construction: every entry this engine saves is one a
+            # fresh replica can load.
+            from ..telemetry.watchdogs import suppress_nan_sentinel
+            with suppress_nan_sentinel():
+                return self._compile_traced(key)
+        return self._compile_traced(key)
+
+    def _compile_traced(self, key: Tuple[str, int, int, int, str]):
         import jax
         import jax.numpy as jnp
 
@@ -240,8 +278,11 @@ class InferenceEngine:
             return self._spoison_fn.lower(fbuf, idx).compile()
         assert kind == "szero", kind
         shapes = self._slot_specs(h, w)
-        zero = jax.jit(lambda: tuple(jnp.zeros(s.shape, s.dtype)
-                                     for s in shapes))
+        # tree.map (not a flat tuple comprehension): under quant the
+        # fmap/cnet entries are nested (vals, scales) pytrees and the
+        # zeroed buffers must mirror that structure
+        zero = jax.jit(lambda: jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes))
         return zero.lower().compile()
 
     def _get_executable(self, key: Tuple[int, int, int, str]):
@@ -263,9 +304,18 @@ class InferenceEngine:
     def warmup(self, verbose: bool = True) -> int:
         """AOT-compile every declared (bucket, batch-step); returns the
         number of executables built.  Warmup compiles are not counted as
-        cache misses — `compile_misses` measures serve-time surprises."""
+        cache misses — `compile_misses` measures serve-time surprises.
+
+        With an attached AOT cache (serving/aot_cache.EngineCache) every
+        key LOAD-OR-COMPILES: a valid serialized entry deserializes in
+        milliseconds and fires no XLA compile event (RecompileWatch sees
+        nothing), a miss compiles and is exported for the next replica.
+        ``warmup_loaded`` counts the loads; the manifest is (re)stamped
+        with the grid afterwards so the directory advertises exactly the
+        keys it holds."""
         t0 = time.monotonic()
         n = 0
+        loaded = 0
         # the grid is enumerated by the static budget analyzer
         # (lint/budget.py) and consumed here, so `raftlint --budget`
         # capacity reports and the live compile surface are one list by
@@ -278,15 +328,43 @@ class InferenceEngine:
             with self._lock:
                 if key in self._exec:
                     continue
-            ex = self._compile(key)
+            ex = self.cache.load(key) if self.cache is not None else None
+            from_cache = ex is not None
+            if ex is None:
+                ex = self._compile(key)
+                if self.cache is not None:
+                    self.cache.save(key, ex)
             with self._lock:
                 self._exec.setdefault(key, ex)
             n += 1
+            loaded += int(from_cache)
             if verbose:
-                _log.info(f"warmed {kind} bucket {h}x{w} batch {b} "
+                verb = "loaded" if from_cache else "warmed"
+                _log.info(f"{verb} {kind} bucket {h}x{w} batch {b} "
                           f"({time.monotonic() - t0:.1f}s elapsed)")
+        if self.cache is not None:
+            self.cache.write_manifest(grid)
         self.warmup_seconds = time.monotonic() - t0
+        self.warmup_loaded = loaded
         return n
+
+    def export_cache(self) -> dict:
+        """Export every in-memory executable plus the manifest into the
+        attached AOT cache — the /admin/cache/prestage hook the fleet's
+        RollingUpdater calls before flipping weights, so a post-swap
+        respawn finds a fully-populated shared directory.  Idempotent
+        (existing entries are kept); a no-op without a cache."""
+        if self.cache is None:
+            return {"exported": 0, "entries": 0, "dir": None}
+        with self._lock:
+            items = list(self._exec.items())
+        exported = sum(1 for key, ex in items if self.cache.save(key, ex))
+        grid = enumerate_warmup_grid(self.config, self.sconfig,
+                                     stream=self.stream,
+                                     chaos=self.faults is not None)
+        self.cache.write_manifest(grid)
+        return {"exported": exported, "entries": len(items),
+                "dir": str(self.cache.dir)}
 
     def _ensure_slot_buffers(self, bucket: Tuple[int, int]) -> None:
         """Build this bucket's pool buffers via the warmed ``szero``
@@ -347,6 +425,11 @@ class InferenceEngine:
         import jax
         from jax.tree_util import tree_flatten_with_path
 
+        if self.config.quant_weights:
+            # same cast the constructor applied: the swap template (leaf
+            # dtypes included) must match the serving tree
+            from ..models.raft import cast_encoder_weights
+            params = cast_encoder_weights(params, self.config)
         staged = jax.tree.map(jax.numpy.asarray, params)
         old_paths, old_td = tree_flatten_with_path(self.params)
         new_paths, new_td = tree_flatten_with_path(staged)

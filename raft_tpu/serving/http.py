@@ -308,6 +308,14 @@ class _Handler(BaseHTTPRequestHandler):
                         "sample": app.sconfig.trace_sample,
                         "open_traces": app.tracer.open_traces,
                     }
+                cache = getattr(app, "engine_cache", None)
+                if cache is not None:
+                    # fleet stagger-skip + the coldstart bench read this:
+                    # misses == 0 (with hits > 0) means this replica booted
+                    # entirely from the serialized AOT cache
+                    ec = cache.stats.as_dict()
+                    ec["dir"] = str(cache.dir)
+                    health["engine_cache"] = ec
                 streams = getattr(app, "streams", None)
                 if streams is not None:
                     health["stream"] = {
@@ -365,6 +373,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if path == "/admin/reload":
             self._post_admin_reload()
+            return
+        if path == "/admin/cache/prestage":
+            self._post_admin_cache_prestage()
             return
         if path != "/v1/flow":
             self._send_json(404, {"error": f"no handler for {path}"})
@@ -462,6 +473,25 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(500, {"error": f"reload failed: {e}"})
             return
         self._send_json(200, {"status": "reloaded", "weights": info})
+
+    def _post_admin_cache_prestage(self):
+        """Export every warmed executable into the attached AOT cache dir
+        (serving/aot_cache.py) and rewrite the manifest — what the rolling
+        updater calls on one healthy replica BEFORE flipping weights, so
+        every later spawn/respawn boots compile-free.  Cheap relative to
+        a compile (serialize + atomic rename per key), and runs on this
+        handler thread like /admin/reload."""
+        app = self.server_app
+        if getattr(app, "engine_cache", None) is None:
+            self._send_json(409, {"error": "no engine cache attached "
+                                           "(--engine-cache-dir)"})
+            return
+        try:
+            info = app.prestage_cache()
+        except Exception as e:
+            self._send_json(500, {"error": f"prestage failed: {e}"})
+            return
+        self._send_json(200, {"status": "prestaged", "cache": info})
 
     def _post_stream(self):
         app = self.server_app

@@ -228,6 +228,33 @@ def make_robustness_metrics(registry: Registry,
     return m
 
 
+def make_engine_cache_metrics(registry: Registry) -> Dict[str, _Metric]:
+    """AOT executable-cache families (serving/aot_cache.py) — registered
+    only when --engine-cache-dir attaches a cache, so a cacheless server's
+    /metrics exposition is untouched.  The counters are bulk-filled from
+    the cache's warmup stats after start() and incremented on later
+    export/prestage activity; the histogram prices deserialize time (the
+    thing that replaced a multi-second XLA compile)."""
+    return {
+        "hits": registry.counter(
+            "raft_engine_cache_hits_total",
+            "Warmup keys served from the serialized AOT cache "
+            "(deserialized executable — no XLA compile)"),
+        "misses": registry.counter(
+            "raft_engine_cache_misses_total",
+            "Warmup keys that fell back to compiling (absent, corrupt, "
+            "or stale cache directory)"),
+        "loads": registry.counter(
+            "raft_engine_cache_loads_total",
+            "Serialized-executable deserialize attempts"),
+        "load_seconds": registry.histogram(
+            "raft_engine_cache_load_seconds",
+            "Deserialize time per cached executable (the cold-start cost "
+            "that replaced an XLA compile)",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)),
+    }
+
+
 def make_fault_metrics(registry: Registry) -> Dict[str, _Metric]:
     """Registered only when --chaos/RAFT_TPU_CHAOS arms the injector, so
     an un-drilled server's /metrics exposition carries no chaos families."""

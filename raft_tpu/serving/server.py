@@ -196,6 +196,20 @@ class FlowServer:
             for k in ("steps", "step_seconds", "step_batch",
                       "step_occupancy"):
                 self.metrics[f"stream_{k}"] = stream_metrics[k]
+        # AOT executable cache (serving/aot_cache.py): keyed by the
+        # RESOLVED config (the engine applies the sconfig iters-policy
+        # override, so the cache identity must match the warmed keys)
+        self.engine_cache = None
+        if engine is None and sconfig.engine_cache_dir:
+            import dataclasses as _dc
+
+            from .aot_cache import EngineCache
+            rconfig = config
+            if sconfig.iters_policy is not None:
+                rconfig = _dc.replace(config,
+                                      iters_policy=sconfig.iters_policy)
+            self.engine_cache = EngineCache(sconfig.engine_cache_dir,
+                                            rconfig)
         # engine injection: tests drive the batching policy with stubs.
         # A streaming engine shares the coordinator's slot pool: the
         # store owns the alloc/free policy, the engine owns the device
@@ -203,7 +217,8 @@ class FlowServer:
         self.engine = engine if engine is not None else InferenceEngine(
             config, params, sconfig, iters=iters,
             stream=sconfig.max_sessions > 0, faults=self.faults,
-            pool=self.streams.pool if self.streams else None)
+            pool=self.streams.pool if self.streams else None,
+            cache=self.engine_cache)
         self.batcher = MicroBatcher(
             self.queue, self._run_engine, sconfig.pad_batch_to,
             sconfig.max_batch, sconfig.max_wait_ms, metrics=self.metrics,
@@ -289,6 +304,22 @@ class FlowServer:
         if run_log is not None:
             run_log.event("serve_weights_reloaded", version=info["version"],
                           tag=info.get("tag"), probed=info.get("probed"))
+        return info
+
+    def prestage_cache(self) -> dict:
+        """POST /admin/cache/prestage: export every in-memory executable
+        (plus the manifest) into the attached AOT cache directory — the
+        fleet's RollingUpdater calls this on a healthy replica before a
+        weight flip so any post-swap respawn boots compile-free.  Returns
+        {exported, entries, dir}; a server without a cache reports
+        exported=0 with dir=None (the updater treats that as
+        'nothing to pre-stage', not an error)."""
+        export = getattr(self.engine, "export_cache", None)
+        info = export() if export is not None else {
+            "exported": 0, "entries": 0, "dir": None}
+        run_log = tlm_events.current()
+        if run_log is not None:
+            run_log.event("serve_cache_prestaged", **info)
         return info
 
     # -- self-healing hooks ------------------------------------------------
@@ -385,8 +416,24 @@ class FlowServer:
         if self.sconfig.warmup and hasattr(self.engine, "warmup"):
             n = self.engine.warmup(verbose=self.verbose)
             if self.verbose:
-                _log.info(f"warmup compiled {n} executable(s) in "
+                loaded = getattr(self.engine, "warmup_loaded", 0)
+                _log.info(f"warmup built {n} executable(s) "
+                          f"({loaded} loaded from the AOT cache, "
+                          f"{n - loaded} compiled) in "
                           f"{self.engine.warmup_seconds:.1f}s")
+        if self.engine_cache is not None:
+            # bulk-fill the cache families from the warmup stats (the
+            # metric registration itself is gated on the cache existing,
+            # so a cacheless /metrics exposition is untouched)
+            from .metrics import make_engine_cache_metrics
+            fam = make_engine_cache_metrics(self.registry)
+            st = self.engine_cache.stats
+            for name in ("hits", "misses", "loads"):
+                count = getattr(st, name)
+                if count:
+                    fam[name].inc(count)
+            for sec in st.load_seconds:
+                fam["load_seconds"].observe(sec)
         if self._recompile_watch is not None:
             self._recompile_watch.arm()
         self.batcher.start()
@@ -571,6 +618,7 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
             # instead of letting ServeConfig raise on it
             max_sessions=getattr(args, "max_sessions", 64),
             session_ttl_s=getattr(args, "session_ttl_s", 300.0),
+            engine_cache_dir=getattr(args, "engine_cache_dir", None),
             # chaos drills: the CLI flag wins, the env var arms CI/ops.
             # breaker knobs use None-checks, not `or`: --breaker-window 0
             # is the documented breaker-off switch and must survive
@@ -604,7 +652,13 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
     if server.streams is not None:
         print(f"[serve] streaming: max_sessions={sconfig.max_sessions}  "
               f"session_ttl={sconfig.session_ttl_s:.0f}s  "
+              f"quant={config.quant}  "
               f"POST {server.url}/v1/stream")
+    if server.engine_cache is not None:
+        st = server.engine_cache.stats
+        print(f"[serve] engine cache: dir={server.engine_cache.dir}  "
+              f"loaded={st.hits}  compiled={st.misses}  "
+              f"(warmup {server.engine.warmup_seconds:.1f}s)")
     if server.faults is not None:
         print(f"[serve] CHAOS ARMED: {sconfig.chaos} "
               f"(fault injection live — drills only)")

@@ -58,7 +58,7 @@ from ..telemetry.watchdogs import watched_lock
 RECORD_CAP_FACTOR = 4
 
 
-def make_slot_commit_fn():
+def make_slot_commit_fn(quant: bool = False):
     """The slot-pool scatter: ``(fmap_buf, cnet_buf, flow_buf, slots [b],
     fmap_rows [b,...], cnet_rows [b,...], seed_rows [b,...], mask [b])
     -> (fmap_buf, cnet_buf, flow_buf)`` — rows with ``mask=True`` replace
@@ -72,6 +72,13 @@ def make_slot_commit_fn():
     deterministic.  The serving engine compiles this per (bucket, width)
     with the buffers DONATED (off-CPU), so a commit is an in-place row
     update of the pool, not a buffer copy.
+
+    With ``quant=True`` (``RAFTConfig.quant='int8'``) the fmap/cnet
+    buffers arrive as ``(int8 vals, per-channel f32 scales)`` 2-leaf
+    pytrees; the incoming f32 rows are quantized ON SCATTER
+    (models/raft.quantize_rows) and both leaves are masked-written.  The
+    flow seed buffer stays f32.  Call-site signatures are unchanged —
+    jit handles the pytree args.
     """
     import jax.numpy as jnp
 
@@ -80,19 +87,38 @@ def make_slot_commit_fn():
         def put(buf, rows):
             keep = mask.reshape((-1,) + (1,) * (rows.ndim - 1))
             return buf.at[slots].set(jnp.where(keep, rows, buf[slots]))
+
+        if quant:
+            from ..models.raft import quantize_rows
+
+            def put_q(buf, rows):
+                vals_buf, scale_buf = buf
+                vals, scales = quantize_rows(rows)
+                return (put(vals_buf, vals), put(scale_buf, scales))
+
+            return (put_q(fmap_buf, fmap_rows), put_q(cnet_buf, cnet_rows),
+                    put(flow_buf, seed_rows))
         return (put(fmap_buf, fmap_rows), put(cnet_buf, cnet_rows),
                 put(flow_buf, seed_rows))
     return fn
 
 
-def make_slot_poison_fn():
+def make_slot_poison_fn(quant: bool = False):
     """Chaos ``session`` arm, slot-pool form: NaN-poison one slot's fmap
     row in place (``(fmap_buf, slots [1]) -> fmap_buf``) so the poison
     propagates through the correlation volume into the flow output — the
-    non-finite sentinel must then catch it and degrade that row cold."""
+    non-finite sentinel must then catch it and degrade that row cold.
+
+    Under ``quant=True`` the int8 value rows cannot hold a NaN, so the
+    poison NaNs the slot's f32 SCALE row instead — dequant-on-gather
+    (``vals * NaN``) then yields NaN across the whole row, preserving the
+    drill's propagation contract."""
     import jax.numpy as jnp
 
     def fn(fmap_buf, slots):
+        if quant:
+            vals_buf, scale_buf = fmap_buf
+            return (vals_buf, scale_buf.at[slots].multiply(jnp.nan))
         return fmap_buf.at[slots].multiply(jnp.nan)
     return fn
 

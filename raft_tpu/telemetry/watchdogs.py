@@ -36,6 +36,7 @@ is enabled at trace time, and ``watched_lock`` hands back a plain
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -199,6 +200,7 @@ def hbm_gauges(registry, prefix: str = "raft") -> dict:
 # ----------------------------------------------------------- NaN sentinel
 
 _nan_enabled = False
+_nan_suppressed = False
 _nan_events: List[dict] = []
 _nan_run_log = None
 
@@ -213,8 +215,29 @@ def enable_nan_sentinel(on: bool = True, run_log=None) -> None:
         _nan_events.clear()
 
 
+@contextlib.contextmanager
+def suppress_nan_sentinel():
+    """Trace-time escape hatch: functions compiled under this context
+    carry no sentinel callback even when watchdogs are on.
+
+    Exists for the AOT executable cache (serving/aot_cache.py):
+    ``jax.experimental.serialize_executable`` pickles the unloaded
+    executable, and a ``jax.debug.callback`` trampoline is a PyCapsule —
+    unpicklable, so a sentinel-carrying executable can never round-trip
+    through the cache.  A cache-attached engine compiles its whole grid
+    under this context so every entry it saves is loadable; the sentinel
+    still guards training and cacheless serving."""
+    global _nan_suppressed
+    prev = _nan_suppressed
+    _nan_suppressed = True
+    try:
+        yield
+    finally:
+        _nan_suppressed = prev
+
+
 def nan_sentinel_enabled() -> bool:
-    return _nan_enabled or watchdogs_enabled()
+    return not _nan_suppressed and (_nan_enabled or watchdogs_enabled())
 
 
 def nan_events() -> List[dict]:

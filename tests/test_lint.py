@@ -586,6 +586,54 @@ from raft_tpu.lint.budget import VMEM_BYTES
 def fits(nbytes):
     return nbytes <= VMEM_BYTES
 """),
+    # B2 cache extension: a kind covered only by export_cache (the AOT
+    # serialization surface) counts as warmed — it deserializes at boot
+    ("B2", """
+class Engine:
+    def warmup(self):
+        for kind in ("pair",):
+            self._compile(kind)
+
+    def _compile(self, kind):
+        if kind == "pair":
+            return self._pair()
+        if kind == "cached":
+            return self._cached()
+""", """
+class Engine:
+    def warmup(self):
+        for kind in ("pair",):
+            self._compile(kind)
+
+    def export_cache(self):
+        for kind in ("pair", "cached"):
+            self._save(kind)
+
+    def _compile(self, kind):
+        if kind == "pair":
+            return self._pair()
+        if kind == "cached":
+            return self._cached()
+"""),
+    ("B5", """
+KEY_FIELDS = ("kind", "h", "w", "b")
+
+def enumerate_warmup_grid(config, sconfig):
+    keys = []
+    for (h, w, b, kind) in grid(config, sconfig):
+        key = (kind, h, w, b, policy)
+        keys.append(key)
+    return keys
+""", """
+KEY_FIELDS = ("kind", "h", "w", "b", "policy")
+
+def enumerate_warmup_grid(config, sconfig):
+    keys = []
+    for (h, w, b, kind) in grid(config, sconfig):
+        key = (kind, h, w, b, policy)
+        keys.append(key)
+    return keys
+"""),
 ]
 
 

@@ -199,7 +199,7 @@ def _parse_serve_args(spec: str):
     tools/serve.py flags): --small, --buckets HxW[,HxW...], --max-batch N,
     --batch-steps a,b,..., --max-sessions N, --iters-policy SPEC,
     --iters N, --chaos SPEC, --dp-devices N, --compute-dtype D,
-    --corr-impl I, --gru-impl I.
+    --corr-impl I, --gru-impl I, --quant Q, --engine-cache-dir DIR.
     """
     import shlex
 
@@ -244,6 +244,10 @@ def _parse_serve_args(spec: str):
             model["corr_impl"] = value(t)
         elif t == "--gru-impl":
             model["gru_impl"] = value(t)
+        elif t == "--quant":
+            model["quant"] = value(t)
+        elif t == "--engine-cache-dir":
+            serve["engine_cache_dir"] = value(t)
         else:
             raise ValueError(f"unknown --serve-args token {t!r}")
         i += 1

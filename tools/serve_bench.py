@@ -74,6 +74,18 @@ dropped requests, requests served DURING the roll, zero compile-cache
 misses on every replica (params are runtime args, same avals -> same
 executables).  ``--smoke`` gates all of it for CI; the full run
 additionally gates aggregate scaling >= 1.7x one replica.
+
+``--coldstart`` is the AOT-cache boot race: a cold in-process boot
+(empty ``--engine-cache-dir`` — every warmup executable compiles, then
+serializes) against a cached boot of a brand-new server on the same
+directory (everything deserializes).  Each phase times server start +
+time-to-first-200 and counts XLA compiles with its own watchdog
+instance; the record adds the budget analyzer's f32-vs-int8 per-session
+slot bytes.  Gated in smoke AND full runs: cached boot is fully
+cache-warm (misses == 0), compiles nothing, reaches its first 200 >= 5x
+faster, and int8 rows are >= 2x denser than f32.  The fleet arm shares
+one cache dir across replicas, so its kill drill also asserts the
+respawned replica deserializes instead of recompiling.
 """
 
 from __future__ import annotations
@@ -1014,6 +1026,27 @@ def _fleet_chaos_drill(args, host, port, manager, fcfg):
             break
         time.sleep(0.5)
 
+    # the respawn must be a CACHE boot: the fleet shares one AOT cache
+    # dir, the dead replica's executables were serialized at its own
+    # warmup, so its replacement deserializes everything — healthz
+    # engine_cache misses == 0 with hits > 0, no compile storm
+    respawn_cache = None
+    if healed_s is not None:
+        respawn = max(manager.replicas(), key=lambda r: r.idx)
+        manager.poll_once()
+        respawn_cache = (respawn.health or {}).get("engine_cache")
+        if not respawn_cache:
+            problems.append(f"respawned replica {respawn.idx} reports no "
+                            f"engine_cache on /healthz (shared AOT cache "
+                            f"not wired?)")
+        elif respawn_cache.get("misses", 1) != 0 \
+                or not respawn_cache.get("hits"):
+            problems.append(
+                f"respawned replica {respawn.idx} recompiled instead of "
+                f"loading the shared AOT cache (hits="
+                f"{respawn_cache.get('hits')} "
+                f"misses={respawn_cache.get('misses')})")
+
     failures = sum(v for k, v in statuses.items() if k != "200")
     if failures:
         problems.append(f"{failures} innocent stream failure(s) during "
@@ -1046,6 +1079,7 @@ def _fleet_chaos_drill(args, host, port, manager, fcfg):
         "flow_matches_pairwise": pair_match,
         "max_pairwise_diff": pair_diff,
         "respawned_in_s": healed_s,
+        "respawn_engine_cache": respawn_cache,
         "restarts": manager.restarts,
     }
     return rec, problems
@@ -1189,6 +1223,13 @@ def run_fleet_bench(args) -> int:
             "--queue-depth", str(args.queue_depth),
             "--deadline-ms", str(args.deadline_ms),
             "--max-sessions", str(max(args.max_sessions, sessions))]
+    # one SHARED AOT executable cache for the whole fleet (mirrors the
+    # fleet/launch.py default): replica 0 compiles + serializes, every
+    # later spawn — including the chaos drill's respawn — deserializes
+    base += ["--engine-cache-dir",
+             args.engine_cache_dir or os.path.join(out_dir, "engine-cache")]
+    if args.quant:
+        base += ["--quant", args.quant]
     if args.small:
         base.append("--small")
     if args.iters:
@@ -1366,6 +1407,211 @@ def run_fleet_bench(args) -> int:
     return 0
 
 
+def run_coldstart_bench(args) -> int:
+    """--coldstart: the AOT executable-cache boot race.
+
+    Two in-process boots of the SAME server config against one cache
+    directory.  Phase COLD starts with the directory empty: every warmup
+    executable compiles and is serialized on the way out
+    (``jax.experimental.serialize_executable``, keyed by the budget
+    analyzer's warmup grid).  Phase CACHED constructs a brand-new
+    FlowServer — new engine, new jit closures, so jax's in-memory
+    compile cache cannot flatter it — against the now-populated
+    directory: every executable deserializes.  Each phase times
+    ``server.start()`` and the time to its first served 200, and counts
+    every XLA backend compile with a bench-owned RecompileWatch (the
+    process-wide listener keeps per-instance counts, so each phase reads
+    only its own).
+
+    Gated in BOTH smoke and full runs: the cached boot loads the whole
+    grid (cache stats: misses == 0, hits == the cold phase's saves),
+    compiles NOTHING — zero XLA compile events across its warmup AND the
+    serving drive — and reaches its first 200 at least 5x faster than
+    the cold boot.  The record also carries the quantized
+    session-density half of the story: the budget analyzer's per-session
+    slot-pool bytes f32 vs int8, gated at >= 2x density (int8 rows must
+    fit at least twice the f32 session count in the same envelope).
+    """
+    import dataclasses
+    import tempfile
+
+    from raft_tpu.config import RAFTConfig, init_rng
+    from raft_tpu.lint import budget as budget_lib
+    from raft_tpu.models import init_raft
+    from raft_tpu.serving import FlowServer, ServeConfig, parse_buckets
+    from raft_tpu.serving.aot_cache import cache_identity
+    from raft_tpu.telemetry.watchdogs import RecompileWatch
+
+    h, w = args.size
+    bucket_spec = args.buckets or f"{-(-h // 8) * 8}x{-(-w // 8) * 8}"
+    config = (RAFTConfig.small_model(iters=args.iters)
+              if args.small else RAFTConfig.full(iters=args.iters or 12))
+    if args.quant:
+        config = dataclasses.replace(config, quant=args.quant)
+    if args.load:
+        from raft_tpu.convert import load_checkpoint_auto
+        params = load_checkpoint_auto(args.load)
+    else:
+        params = init_raft(init_rng(), config)
+
+    cache_dir = args.engine_cache_dir
+    if cache_dir is None:
+        cache_dir = tempfile.mkdtemp(prefix="raft_coldstart_cache_")
+    elif os.path.isdir(cache_dir) and os.listdir(cache_dir):
+        print(f"ERROR: --coldstart needs an EMPTY cache dir for the cold "
+              f"phase; {cache_dir!r} has entries (point --engine-cache-dir "
+              f"somewhere fresh, or omit it for a temp dir)")
+        return 2
+
+    def make_sconfig():
+        return ServeConfig(
+            buckets=parse_buckets(bucket_spec), max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms, queue_depth=args.queue_depth,
+            default_deadline_ms=args.deadline_ms, port=0,
+            iters_policy=args.iters_policy,
+            max_sessions=args.max_sessions,
+            trace_sample=(1.0 if args.trace_sample is None
+                          else args.trace_sample),
+            engine_cache_dir=cache_dir)
+
+    rng = np.random.RandomState(0)
+    im1 = rng.rand(h, w, 3).astype(np.float32)
+    im2 = np.clip(im1 + rng.randn(h, w, 3).astype(np.float32) * 0.05, 0, 1)
+    body = _npz(image1=im1, image2=im2)
+
+    def boot(tag):
+        """One arm of the race: fresh server, shared cache dir."""
+        watch = RecompileWatch(log_fn=lambda *_: None).install()
+        sc = make_sconfig()
+        server = FlowServer(config, params, sc, verbose=False)
+        t0 = time.monotonic()
+        server.start()
+        warmup_s = time.monotonic() - t0
+        warmup_compiles = watch.compiles
+        res, _ = run_closed(sc.host, server.port, body, 1, 1)
+        first_200_s = time.monotonic() - t0
+        first_status = res[0][0] if res else None
+        drive, el = run_closed(sc.host, server.port, body, args.clients,
+                               args.requests)
+        ok = sum(1 for st, _ in drive if st == 200)
+        stats = server.engine_cache.stats.as_dict()
+        rec = {
+            "warmup_s": round(warmup_s, 3),
+            "first_200_s": round(first_200_s, 3),
+            "first_status": first_status,
+            "executables": server.engine_executables(),
+            "warmup_loaded": getattr(server.engine, "warmup_loaded", 0),
+            "xla_compiles_warmup": warmup_compiles,
+            "xla_compiles_total": watch.compiles,
+            "drive_ok": ok, "drive_total": len(drive),
+            "drive_pairs_per_sec": round(ok / el, 3) if el else 0.0,
+            "cache": stats,
+        }
+        server.stop()
+        watch.remove()
+        print(f"[bench] {tag}: first 200 in {rec['first_200_s']}s "
+              f"({rec['xla_compiles_total']} XLA compile(s), "
+              f"{rec['warmup_loaded']}/{rec['executables']} executable(s) "
+              f"from cache, hits={stats['hits']} misses={stats['misses']})")
+        return rec
+
+    print(f"[bench] coldstart race: buckets={bucket_spec} "
+          f"quant={config.quant} max_sessions={args.max_sessions} "
+          f"cache={cache_dir}")
+    cold = boot("cold  ")
+    cached = boot("cached")
+
+    speedup = (round(cold["first_200_s"] / cached["first_200_s"], 2)
+               if cached["first_200_s"] else None)
+
+    # the quantized-density half: same serving envelope, f32 vs int8 slot
+    # rows, priced by the same static analyzer that wrote BUDGET.json
+    sc = make_sconfig()
+    rep_f32 = budget_lib.analyze(
+        dataclasses.replace(config, quant="none"), sc)
+    rep_int8 = budget_lib.analyze(
+        dataclasses.replace(config, quant="int8"), sc)
+    psb_f = rep_f32["totals"]["per_session_bytes"]
+    psb_q = rep_int8["totals"]["per_session_bytes"]
+    density = {
+        "per_session_bytes_f32": psb_f,
+        "per_session_bytes_int8": psb_q,
+        "density_ratio": round(psb_f / psb_q, 2) if psb_q else None,
+        "max_sessions_fit_f32": rep_f32["totals"]["max_sessions_fit"],
+        "max_sessions_fit_int8": rep_int8["totals"]["max_sessions_fit"],
+        "device_kind": "tpu-v4",
+    }
+
+    problems = []
+    if cold["xla_compiles_total"] == 0:
+        problems.append("cold boot compiled nothing — the race is "
+                        "vacuous (warmup grid empty?)")
+    if cold["cache"]["saves"] == 0:
+        problems.append("cold boot serialized no executables")
+    if cached["cache"]["misses"] != 0 or not cached["cache"]["hits"]:
+        problems.append(
+            f"cached boot was not fully cache-warm (hits="
+            f"{cached['cache']['hits']} misses={cached['cache']['misses']})")
+    if cached["cache"]["hits"] != cold["cache"]["saves"]:
+        problems.append(
+            f"cached hits ({cached['cache']['hits']}) != cold saves "
+            f"({cold['cache']['saves']}) — grid drifted between boots")
+    if cached["xla_compiles_total"] != 0:
+        problems.append(f"cached boot compiled "
+                        f"{cached['xla_compiles_total']} executable(s) "
+                        f"(contract: zero, everything deserializes)")
+    if cold["first_status"] != 200 or cached["first_status"] != 200:
+        problems.append(f"first request not 200 (cold="
+                        f"{cold['first_status']} "
+                        f"cached={cached['first_status']})")
+    bad = (cold["drive_total"] - cold["drive_ok"]
+           + cached["drive_total"] - cached["drive_ok"])
+    if bad:
+        problems.append(f"{bad} non-200(s) in the serving drives")
+    if speedup is not None and speedup < 5.0:
+        problems.append(f"cached first-200 only {speedup}x faster than "
+                        f"cold (< 5x)")
+    if density["density_ratio"] is None or density["density_ratio"] < 2.0:
+        problems.append(f"int8 session density only "
+                        f"x{density['density_ratio']} over f32 (< 2x)")
+    fit_q = density["max_sessions_fit_int8"]
+    if fit_q is not None and fit_q < 2 * args.max_sessions:
+        problems.append(f"int8 rows fit only {fit_q} sessions "
+                        f"(< 2x --max-sessions={args.max_sessions})")
+
+    rec = {
+        "bench": "serving_coldstart",
+        "image_hw": [h, w], "buckets": bucket_spec,
+        "quant": config.quant,
+        "iters_policy": args.iters_policy,
+        "max_sessions": args.max_sessions,
+        "cache_dir": cache_dir,
+        "cache_identity": cache_identity(config),
+        "cold": cold, "cached": cached,
+        "first_200_speedup": speedup,
+        "warmup_speedup": (round(cold["warmup_s"] / cached["warmup_s"], 2)
+                           if cached["warmup_s"] else None),
+        "density": density,
+    }
+    from raft_tpu.telemetry import run_manifest
+    rec["manifest"] = run_manifest(config=config,
+                                   mode="serve_bench_coldstart")
+    print(json.dumps(rec, indent=2))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"[bench] appended to {args.out}")
+
+    if problems:
+        print("[bench] " + ("SMOKE FAIL: " if args.smoke
+                            else "COLDSTART FAIL: ") + "; ".join(problems))
+        return 1
+    print(f"[bench] coldstart: cached boot {speedup}x faster, "
+          f"0 compiles, int8 density x{density['density_ratio']}"
+          + (" — SMOKE PASS" if args.smoke else ""))
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description="serving load generator")
     p.add_argument("--url", default=None,
@@ -1455,6 +1701,24 @@ def main() -> int:
                    help="fleet arm: replica count (the scaling ratio is "
                         "measured against a one-replica phase of the "
                         "same fleet, same pinning)")
+    p.add_argument("--coldstart", action="store_true",
+                   help="AOT-cache boot race: cold boot (empty cache dir, "
+                        "everything compiles + serializes) vs cached boot "
+                        "(fresh server, same dir, everything "
+                        "deserializes) — times server start + "
+                        "time-to-first-200 and counts XLA compiles per "
+                        "phase.  Gates: cached boot misses=0 / zero "
+                        "compiles / >= 5x faster first 200, int8 slot "
+                        "density >= 2x f32")
+    p.add_argument("--engine-cache-dir", default=None, metavar="DIR",
+                   help="serialized-executable cache dir for the "
+                        "in-process server (--coldstart: must be empty "
+                        "or absent; default: a temp dir)")
+    p.add_argument("--quant", default=None,
+                   choices=["none", "int8", "bf16w", "int8+bf16w"],
+                   help="post-training quantization for the in-process "
+                        "server (RAFTConfig.quant): int8 slot-pool rows, "
+                        "bf16 encoder weights, or both")
     args = p.parse_args()
 
     if args.chaos and (args.url or args.video):
@@ -1464,6 +1728,11 @@ def main() -> int:
     if args.fleet and (args.url or args.video):
         print("ERROR: --fleet spawns its own subprocess fleet "
               "(no --url / --video)")
+        return 2
+    if args.coldstart and (args.url or args.video or args.chaos
+                           or args.fleet):
+        print("ERROR: --coldstart races two in-process boots "
+              "(no --url / --video / --chaos / --fleet)")
         return 2
 
     if args.smoke:
@@ -1495,6 +1764,15 @@ def main() -> int:
         os.environ["RAFT_TPU_WATCHDOGS"] = "1"
     if args.cpu:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.coldstart:
+        if args.smoke:
+            # the race needs a real grid but not 64 sessions of slots;
+            # the stream kinds (sbatch/scommit/szero/spoison) still warm
+            args.max_sessions = min(args.max_sessions, 8)
+            if args.quant is None:
+                args.quant = "int8"    # smoke covers quantized round-trip
+        return run_coldstart_bench(args)
 
     if args.fleet:
         return run_fleet_bench(args)
