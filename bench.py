@@ -461,6 +461,23 @@ def _run(args, t_start: float, result: dict) -> None:
     else:
         print("# budget exceeded; skipping converge arm", file=sys.stderr)
 
+    # ---- quantization arm (ROADMAP item 3 remainder): post-training ----
+    # quant rows ride the winning config: bf16w (encoder weights stored
+    # bf16 — the serving engine's load-time cast) and the int8 SlotPool
+    # row round-trip (quantize-on-scatter / dequantize-on-gather).
+    if time.perf_counter() - t_start <= args.budget:
+        try:
+            result["quant"] = _quant_arm(
+                args, registry, _cfg_for(best_name.split("+")[0]),
+                int(best_name.split(",b")[1]) if ",b" in best_name else B,
+                best, args.iters, (H, W))
+        except Exception as e:  # noqa: BLE001 — the headline must survive
+            traceback.print_exc(file=sys.stderr)
+            prior = f"{result['error']}; " if result["error"] else ""
+            result["error"] = f"{prior}quant arm failed: {type(e).__name__}"
+    else:
+        print("# budget exceeded; skipping quant arm", file=sys.stderr)
+
     if getattr(args, "trace_dir", None):
         # one extra steady-state measurement of the winner under the
         # profiler, so the trace shows exactly the headline configuration
@@ -575,6 +592,108 @@ def _converge_arm(args, registry, base_cfg, bnum: int, fixed_tput: float,
         raise RuntimeError(
             f"{watch.recompiles} XLA compile(s) during the mixed-difficulty "
             f"sweep — the static-shape early-exit contract is broken")
+    return out
+
+
+def _quant_arm(args, registry, base_cfg, bnum: int, fixed_tput: float,
+               iters: int, hw) -> dict:
+    """Measure the post-training quantization rows on the winning config
+    (ROADMAP item 3 remainder).  Two rows:
+
+    bf16w — the serving engine's load-time encoder-weight cast
+    (models.raft.cast_encoder_weights): full-pipeline throughput with the
+    cast params + the encoder param-HBM halving it buys.
+
+    int8 — the SlotPool row format (quantize-on-scatter /
+    dequantize-on-gather): compression ratio of one encoded frame's
+    (fmap, cnet) rows, the reconstruction error of the round-trip, and
+    the round-trip rate (frames/s) — the per-step tax a streaming
+    session pays to fit ~4x more sessions per chip."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.models import init_raft
+    from raft_tpu.models.raft import (cast_encoder_weights, dequantize_rows,
+                                      encode_frame, make_inference_fn,
+                                      quantize_rows)
+
+    def _nbytes(tree) -> int:
+        return int(sum(a.size * a.dtype.itemsize
+                       for a in jax.tree.leaves(tree)))
+
+    H, W = hw
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    im1 = jax.random.uniform(k1, (bnum, H, W, 3), jnp.float32)
+    im2 = jax.random.uniform(k2, (bnum, H, W, 3), jnp.float32)
+    m_tput = registry.get("raft_bench_pairs_per_sec")
+    out = {"rows": []}
+
+    # --- bf16w: encoder weights stored bf16 on device -------------------
+    cfg = dataclasses.replace(base_cfg, quant="bf16w")
+    params = init_raft(jax.random.PRNGKey(0), cfg)
+    enc = {k: params[k] for k in ("fnet", "cnet") if k in params}
+    enc_f32 = _nbytes(enc)
+    qparams = cast_encoder_weights(params, cfg)
+    enc_bf16 = _nbytes({k: qparams[k] for k in ("fnet", "cnet")
+                        if k in qparams})
+    fn = jax.jit(make_inference_fn(cfg, iters=iters))
+    compiled = fn.lower(qparams, im1, im2).compile()
+    dt = _measure(compiled, (qparams, im1, im2))
+    tput = bnum / dt
+    m_tput.labels("quant:bf16w").set(tput)
+    out["rows"].append({
+        "quant": "bf16w",
+        "pairs_per_sec": round(tput, 4),
+        "vs_fixed": round(tput / fixed_tput, 4) if fixed_tput else None,
+        "encoder_bytes_f32": enc_f32,
+        "encoder_bytes_bf16w": enc_bf16,
+        "encoder_hbm_ratio": (round(enc_f32 / enc_bf16, 3)
+                              if enc_bf16 else None),
+    })
+    print(f"# quant:bf16w: {tput:.3f} pairs/s  encoder HBM "
+          f"{enc_f32 / 1e6:.2f} -> {enc_bf16 / 1e6:.2f} MB "
+          f"(x{enc_f32 / max(enc_bf16, 1):.2f})", file=sys.stderr)
+
+    # --- int8: SlotPool row round-trip ----------------------------------
+    enc_fn = jax.jit(lambda p, a: encode_frame(p, a, base_cfg))
+    fmap, cnet = enc_fn(params, im1)
+    rt_fn = jax.jit(lambda r: dequantize_rows(*quantize_rows(r)))
+    dt_rt = _measure(rt_fn, (fmap,))
+    ref = np.asarray(fmap, np.float32)
+    rec = np.asarray(rt_fn(fmap))
+    max_err = float(np.max(np.abs(rec - ref)))
+    # per-channel relative error: absmax maps to 127, so the bound is
+    # half a quantization step ≈ absmax/254 per channel
+    absmax = np.max(np.abs(ref), axis=(1, 2))          # [B, C]
+    rel = float(np.max(np.max(np.abs(rec - ref), axis=(1, 2))
+                       / np.maximum(absmax, 1e-12)))
+    # baseline = what the SlotPool stores WITHOUT quant: the rows as the
+    # encoder emits them (bf16 under bf16 compute, f32 under f32) — so the
+    # ratio is the honest HBM saving for this config, ~2x from bf16 rows
+    # and ~4x from f32 rows
+    raw_bytes = _nbytes(fmap) + _nbytes(cnet)
+    q_bytes = sum(_nbytes(t) for t in
+                  (*quantize_rows(fmap), *quantize_rows(cnet)))
+    out["rows"].append({
+        "quant": "int8-rows",
+        "row_dtype": str(fmap.dtype),
+        "row_bytes_raw": raw_bytes,
+        "row_bytes_int8": q_bytes,
+        "compression": round(raw_bytes / q_bytes, 3) if q_bytes else None,
+        "max_abs_err": round(max_err, 6),
+        "max_rel_err": round(rel, 6),
+        "roundtrip_frames_per_sec": round(bnum / dt_rt, 2),
+    })
+    print(f"# quant:int8-rows: x{raw_bytes / max(q_bytes, 1):.2f} "
+          f"compression vs {fmap.dtype} rows  max_rel_err {rel:.2e}  "
+          f"roundtrip {bnum / dt_rt:.1f} frames/s", file=sys.stderr)
+    if rel > 1.0 / 127.0:
+        raise RuntimeError(
+            f"int8 row round-trip error {rel:.4g} exceeds the one-step "
+            f"bound 1/127 — quantize_rows scale math is broken")
     return out
 
 

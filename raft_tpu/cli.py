@@ -390,6 +390,38 @@ def _build_parser() -> argparse.ArgumentParser:
                         "the replica with ZERO XLA compiles (serve_fleet "
                         "shares one DIR across every replica).  Default "
                         "off: warmup always compiles")
+    # metric time-series + anomaly sentinels (telemetry/timeseries.py,
+    # telemetry/anomaly.py — OBSERVABILITY.md "Time-series & anomaly
+    # detection"): the detection plane over the recovery plane
+    p.add_argument("--history-interval-s", type=float, default=1.0,
+                   metavar="T",
+                   help="serve mode: metric-history sampling interval — a "
+                        "background thread snapshots /metrics every T "
+                        "seconds into a bounded ring (GET /debug/history, "
+                        "anomaly sentinels, metrics_ts.jsonl).  0 disables "
+                        "all three")
+    p.add_argument("--history-window", type=int, default=600, metavar="N",
+                   help="serve mode: metric-history ring depth (samples "
+                        "retained; N x interval seconds of lookback)")
+    p.add_argument("--history-path", default=None, metavar="PATH",
+                   help="serve mode: metric time-series spill (one JSON "
+                        "line per sample, manifest first; tlm top --replay "
+                        "reads it).  Default <--out>/metrics_ts.jsonl; '' "
+                        "keeps the ring + endpoint but skips the file")
+    p.add_argument("--no-anomaly", action="store_true",
+                   help="serve mode: disable the anomaly sentinels (the "
+                        "p95-drift / burn / occupancy / queue / miss-"
+                        "trickle / restart-rate rules armed after warmup; "
+                        "raft_anomaly_active{rule=} + 'anomaly' run-log "
+                        "events)")
+    p.add_argument("--anomaly-window-s", type=float, default=15.0,
+                   metavar="T",
+                   help="serve mode: recent window every sentinel rule "
+                        "evaluates over")
+    p.add_argument("--anomaly-baseline-s", type=float, default=60.0,
+                   metavar="T",
+                   help="serve mode: trailing baseline window for the "
+                        "p95-drift rule (must exceed the rule window)")
     p.add_argument("--quant", default=None,
                    choices=("none", "int8", "bf16w", "int8+bf16w"),
                    help="serve mode: post-training quantization — 'int8' "

@@ -60,6 +60,18 @@ class FleetConfig:
     # Router-side request trace sampling (joined to replica traces via
     # the propagated X-Raft-Trace-Id; 0 disables router spans).
     trace_sample: float = 1.0
+    # -- fleet time-series + replica skew (router.py) ----------------------
+    # Scrape samples retained per replica in the router's history ring
+    # (one per health poll — the router's /debug/history window), and
+    # the replica-skew sentinel: a replica whose p95 request latency
+    # over the trailing skew_window_s exceeds skew_factor x the fleet
+    # median (and the skew_floor_s noise floor) is soft-drained — new
+    # pairwise picks steer away while pinned sessions keep streaming —
+    # until its p95 rejoins the fleet.
+    history_window: int = 600
+    skew_window_s: float = 30.0
+    skew_factor: float = 3.0
+    skew_floor_s: float = 0.050
     # -- autoscaler (controller.py) ----------------------------------------
     # Disabled by default: scale_to is always available manually; the
     # controller thread only runs when autoscale=True.
@@ -102,3 +114,11 @@ class FleetConfig:
             raise ValueError("trace_sample must be in [0, 1]")
         if self.up_after < 1 or self.down_after < 1:
             raise ValueError("up_after/down_after must be >= 1")
+        if self.history_window < 2:
+            raise ValueError("history_window must be >= 2 (derivations "
+                             "need a sample pair)")
+        if self.skew_window_s <= 0:
+            raise ValueError("skew_window_s must be positive")
+        if self.skew_factor <= 1.0:
+            raise ValueError("skew_factor must exceed 1 (a replica at the "
+                             "fleet median is not an outlier)")

@@ -3,8 +3,10 @@
 The autoscaler consumes signals the serving plane ALREADY exports — no
 new replica-side instrumentation: ``raft_slo_burn_rate`` (is any replica
 failing its latency objective?), admission queue fill, shed counters
-(429/breaker_open), and ``raft_breaker_state`` — all read from the
-manager's cached /metrics scrapes.  Decisions are hysteretic and
+(429/breaker_open), ``raft_breaker_state``, and the replica-side anomaly
+sentinels (``raft_anomaly_active`` — a firing rule anywhere in the fleet
+counts as pressure, and scale-down waits until every sentinel clears) —
+all read from the manager's cached /metrics scrapes.  Decisions are hysteretic and
 asymmetric (scale up after ``up_after`` consecutive pressured polls,
 down only after ``down_after`` calm ones, cooldown between events), so
 one hot poll can't thrash the fleet through spawn/drain cycles that cost
@@ -48,6 +50,7 @@ def fleet_signals(manager: ReplicaManager,
     queue_fills = []
     breaker_open = False
     shed_delta = 0.0
+    anomalies = 0.0
     for rep in manager.replicas():
         if not rep.routable or not rep.prom:
             continue
@@ -56,6 +59,8 @@ def fleet_signals(manager: ReplicaManager,
                 burn = max(burn, val)
             elif key.startswith("raft_breaker_state") and val >= 2.0:
                 breaker_open = True
+            elif key.startswith("raft_anomaly_active"):
+                anomalies += val
         queue_fills.append(rep.queue_fill())
         shed = sum(v for k, v in rep.prom.items()
                    if k.startswith("raft_serving_requests_total")
@@ -71,6 +76,7 @@ def fleet_signals(manager: ReplicaManager,
                        if queue_fills else 0.0),
         "breaker_open": breaker_open,
         "shed_rate": shed_delta,
+        "anomaly": anomalies,
     }
 
 
@@ -113,11 +119,13 @@ class Autoscaler:
         pressured = (sig["burn"] > cfg.up_burn_rate
                      or sig["queue_frac"] > cfg.up_queue_frac
                      or sig["breaker_open"]
-                     or sig["shed_rate"] > 0)
+                     or sig["shed_rate"] > 0
+                     or sig.get("anomaly", 0) > 0)
         calm = (sig["burn"] < cfg.down_burn_rate
                 and sig["queue_frac"] < cfg.down_queue_frac
                 and not sig["breaker_open"]
-                and sig["shed_rate"] == 0)
+                and sig["shed_rate"] == 0
+                and sig.get("anomaly", 0) == 0)
         self._pressured = self._pressured + 1 if pressured else 0
         self._calm = self._calm + 1 if calm else 0
         if self.sessions is not None:

@@ -45,10 +45,14 @@ _FORWARDED_FLAGS = (
     ("gru_impl", "--gru-impl"), ("host", "--host"),
     ("quant", "--quant"),
     ("engine_cache_dir", "--engine-cache-dir"),
+    ("history_interval_s", "--history-interval-s"),
+    ("history_window", "--history-window"),
+    ("anomaly_window_s", "--anomaly-window-s"),
+    ("anomaly_baseline_s", "--anomaly-baseline-s"),
 )
 _FORWARDED_SWITCHES = (
     ("small", "--small"), ("no_warmup", "--no-warmup"), ("cpu", "--cpu"),
-    ("rgb", "--rgb"),
+    ("rgb", "--rgb"), ("no_anomaly", "--no-anomaly"),
 )
 
 

@@ -189,6 +189,7 @@ class ReplicaManager:
         self._stop = threading.Event()
         self._poll_thread = None
         self._death_cbs: List[Callable] = []
+        self._poll_cbs: List[Callable] = []
         self._cores = os.cpu_count() or 1
         self.restarts = 0                 # respawns after unplanned deaths
 
@@ -343,6 +344,14 @@ class ReplicaManager:
         manager lock held) when a replica is declared dead."""
         self._death_cbs.append(cb)
 
+    def on_poll(self, cb: Callable) -> None:
+        """Register ``cb(replica)`` — fired from the poll thread (no
+        manager lock held) after each successful health probe, with the
+        fresh ``/healthz`` + ``/metrics`` scrape already on the record.
+        The router's fleet time-series ingests here: one fetch feeds the
+        load view, the autoscaler, AND the per-replica history."""
+        self._poll_cbs.append(cb)
+
     # -- scaling -----------------------------------------------------------
 
     def scale_to(self, n: int, reason: str = "manual") -> int:
@@ -403,6 +412,11 @@ class ReplicaManager:
             ok = self._probe(rep)
             if ok:
                 rep.consecutive_failures = 0
+                for cb in self._poll_cbs:
+                    try:
+                        cb(rep)
+                    except Exception as e:  # noqa: BLE001
+                        _log.warning(f"poll callback failed: {e}")
             else:
                 rep.consecutive_failures += 1
                 if rep.consecutive_failures >= self.config.unhealthy_after:

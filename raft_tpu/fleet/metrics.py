@@ -12,13 +12,14 @@ import functools
 from typing import Dict
 
 from ..serving.metrics import Registry, _Metric
+from ..telemetry.registry import register_process_start_time
 
 REPLICA_STATES = ("starting", "ready", "degraded", "dead", "stopped")
 
 
 def make_fleet_metrics(registry: Registry, manager=None,
-                       sessions_fn=None, inflight_fn=None
-                       ) -> Dict[str, _Metric]:
+                       sessions_fn=None, inflight_fn=None,
+                       skew_fn=None) -> Dict[str, _Metric]:
     """The router/controller metric families.  The live gauges are
     callbacks on the manager / session map (sampled at scrape time, the
     serving-plane idiom) so they can never go stale."""
@@ -85,5 +86,12 @@ def make_fleet_metrics(registry: Registry, manager=None,
             "Replicas respawned after unplanned deaths (chaos kills, "
             "crashes) since the fleet started",
             fn=(lambda: manager.restarts) if manager else None),
+        "replica_skew": registry.gauge(
+            "raft_fleet_replica_skew",
+            "Replicas whose windowed p95 request latency is an outlier "
+            "vs the fleet median (telemetry.anomaly.replica_skew) — the "
+            "router soft-drains them until their p95 rejoins the fleet",
+            fn=skew_fn),
     }
+    register_process_start_time(registry)
     return m

@@ -3,8 +3,13 @@
 The router exposes the UNCHANGED single-replica API — ``POST /v1/flow``,
 ``POST /v1/stream``, ``GET /healthz``, ``GET /metrics``, ``GET
 /debug/traces`` — plus ``POST /admin/reload`` (fleet-wide rolling weight
-hot-swap, controller.py).  Clients cannot tell a fleet from a replica
-except by reading ``meta.replica``.
+hot-swap, controller.py), ``GET /metrics/fleet`` (every replica's last
+scrape re-labeled ``replica="<idx>"`` + summed ``replica="all"``
+rollups), and ``GET /debug/history`` (per-replica derived time-series
+from the router's :class:`~raft_tpu.telemetry.timeseries.ScrapeHistory`
+over the health-poll scrapes, ``?window=`` seconds; includes the
+currently skew-drained replica list).  Clients cannot tell a fleet from
+a replica except by reading ``meta.replica``.
 
 Routing rules (SERVING.md "Fleet"):
 
@@ -44,8 +49,8 @@ import os
 import threading
 import time
 from http.client import HTTPConnection
-from typing import Dict, List, Optional, Tuple
-from urllib.parse import urlsplit
+from typing import Dict, List, Optional, Set, Tuple
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
@@ -54,7 +59,9 @@ from ..serving.http import (BadRequest, _Handler, parse_stream_request,
                             serve_in_thread)
 from ..serving.metrics import Registry
 from ..telemetry import spans as tlm_spans
+from ..telemetry.anomaly import LATENCY, replica_skew
 from ..telemetry.log import get_logger
+from ..telemetry.timeseries import ScrapeHistory
 from ..telemetry.watchdogs import watched_lock
 from .config import FleetConfig
 from .manager import ReplicaManager
@@ -193,6 +200,7 @@ class FleetRouter:
     the ``raft_fleet_*`` registry, and the router-side tracer."""
 
     _inflight = guarded_by("_lock")
+    _skewed = guarded_by("_lock")
 
     def __init__(self, config: FleetConfig, manager: ReplicaManager,
                  out_dir: Optional[str] = None, run_log=None,
@@ -203,12 +211,15 @@ class FleetRouter:
         self.verbose = verbose
         self._lock = watched_lock("FleetRouter._lock")
         self._inflight: Dict[int, int] = {}
+        self._skewed: Set[int] = set()    # latency outliers, soft-drained
         self.sessions = FleetSessionMap()
         self.registry = Registry()
         self.metrics = make_fleet_metrics(
             self.registry, manager=manager,
             sessions_fn=self.sessions.count,
-            inflight_fn=self.total_inflight)
+            inflight_fn=self.total_inflight,
+            skew_fn=self.skew_count)
+        self.fleet_history = ScrapeHistory(window=config.history_window)
         self.flightrec = None
         if config.trace_sample > 0:
             path = (os.path.join(out_dir, "flightrec.jsonl")
@@ -222,6 +233,7 @@ class FleetRouter:
         self._http_thread = None
         self._draining = threading.Event()
         manager.on_death(self._replica_died)
+        manager.on_poll(self._replica_polled)
 
     # -- plumbing ----------------------------------------------------------
 
@@ -232,10 +244,67 @@ class FleetRouter:
         with self._lock:
             return sum(self._inflight.values())
 
+    def skew_count(self) -> int:
+        with self._lock:
+            return len(self._skewed)
+
+    def skewed(self) -> List[int]:
+        """Replica indexes currently judged latency-skewed (sorted)."""
+        with self._lock:
+            return sorted(self._skewed)
+
+    def _replica_polled(self, rep) -> None:
+        """Manager poll callback (poll thread): ingest the fresh
+        ``/metrics`` scrape into the per-replica history ring, then
+        re-judge latency skew across the fleet — the one fetch the
+        manager already made feeds the load view, the autoscaler AND
+        the router's time-series."""
+        if not rep.prom:
+            return
+        self.fleet_history.ingest(str(rep.idx), rep.prom)
+        self._check_skew()
+
+    def _check_skew(self) -> None:
+        """Cross-replica p95 comparison (telemetry.anomaly.replica_skew):
+        one replica running hot while its siblings are fine is a replica
+        problem, not a load problem, so :meth:`_pick` steers NEW pairwise
+        work away (soft-drain, the rolling updater's ``updating`` idiom
+        — pinned sessions and in-flight forwards finish normally) until
+        its windowed p95 rejoins the fleet."""
+        cfg = self.config
+        p95s = {src: self.fleet_history.percentile(
+                    src, LATENCY, 0.95, window_s=cfg.skew_window_s)
+                for src in self.fleet_history.sources()}
+        outliers = {int(s) for s in replica_skew(
+            p95s, factor=cfg.skew_factor, floor_s=cfg.skew_floor_s)}
+        with self._lock:
+            rising = outliers - self._skewed
+            falling = self._skewed - outliers
+            self._skewed = outliers
+        for idx in sorted(rising):
+            p95 = p95s.get(str(idx))
+            _log.warning(f"replica {idx} latency-skewed "
+                         f"(p95 {p95 * 1e3:.1f}ms vs fleet): steering "
+                         f"new picks away")
+            if self.run_log is not None:
+                self.run_log.event("fleet_replica_skew", replica=idx,
+                                   edge="fire",
+                                   p95_ms=round(p95 * 1e3, 3))
+        for idx in sorted(falling):
+            _log.info(f"replica {idx} latency skew cleared")
+            if self.run_log is not None:
+                self.run_log.event("fleet_replica_skew", replica=idx,
+                                   edge="clear")
+
     def _replica_died(self, rep) -> None:
         """Manager death callback (poll thread): nothing to do eagerly —
         migration is lazy, on each pinned session's next advance — but
-        the pinned count is worth a line and an event."""
+        the pinned count is worth a line and an event.  The dead
+        replica's scrape history is dropped (its successor restarts the
+        counters) and any skew verdict on it is moot."""
+        self.fleet_history.forget(str(rep.idx))
+        with self._lock:
+            self._skewed.discard(rep.idx)
         pinned = len(self.sessions.on_replica(rep.idx))
         if pinned:
             _log.warning(f"replica {rep.idx} died with {pinned} pinned "
@@ -247,7 +316,10 @@ class FleetRouter:
     def _pick(self, exclude=()) -> "object":
         """Least-loaded routable replica (fewest router-side in-flight
         forwards, then scraped queue fill); reserves an in-flight slot —
-        callers MUST pair with :meth:`_unpick`."""
+        callers MUST pair with :meth:`_unpick`.  Latency-skewed replicas
+        (:meth:`_check_skew`) are steered around SOFTLY: preferred out
+        when healthy siblings exist, still picked when they are all
+        that's left — skew is a preference, drain is not an outage."""
         cands = [r for r in self.manager.routable() if r.idx not in exclude]
         if not cands:
             # every replica is updating/draining: route to any live one
@@ -257,6 +329,9 @@ class FleetRouter:
         if not cands:
             raise NoReplica("no routable replica")
         with self._lock:
+            unskewed = [r for r in cands if r.idx not in self._skewed]
+            if unskewed:
+                cands = unskewed
             rep = min(cands, key=lambda r: (self._inflight.get(r.idx, 0),
                                             r.queue_fill(), r.idx))
             self._inflight[rep.idx] = self._inflight.get(rep.idx, 0) + 1
@@ -704,6 +779,34 @@ class FleetRouter:
             "replicas": reps,
         }
 
+    def render_fleet_metrics(self) -> str:
+        """``GET /metrics/fleet``: every replica's last scraped
+        exposition re-labeled with ``replica="<idx>"``, plus fleet
+        rollups — the per-sample SUM across replicas (exact for
+        counters and histogram buckets, additive for gauges like queue
+        depth) — as ``replica="all"``.  One scrape target yields both
+        per-replica and total series, derived from the manager's cached
+        polls: no extra replica round-trips at scrape time."""
+        lines: List[str] = []
+        rollup: Dict[str, float] = {}
+        for rep in sorted(self.manager.replicas(), key=lambda r: r.idx):
+            if not rep.routable or not rep.prom:
+                continue
+            for key in sorted(rep.prom):
+                val = rep.prom[key]
+                name, _, rest = key.partition("{")
+                labels = rest.rstrip("}")
+                merged = (f'replica="{rep.idx}"'
+                          + ("," + labels if labels else ""))
+                lines.append(f"{name}{{{merged}}} {val:.10g}")
+                rollup[key] = rollup.get(key, 0.0) + val
+        for key in sorted(rollup):
+            name, _, rest = key.partition("{")
+            labels = rest.rstrip("}")
+            merged = 'replica="all"' + ("," + labels if labels else "")
+            lines.append(f"{name}{{{merged}}} {rollup[key]:.10g}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
     def admin_reload(self, body: bytes,
                      tag: Optional[str]) -> Tuple[int, dict, bytes]:
         """Fleet-wide rolling hot-swap: delegate to the RollingUpdater
@@ -781,6 +884,26 @@ class _RouterHandler(_Handler):
         elif path == "/metrics":
             self._send(200, router.registry.render().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/metrics/fleet":
+            self._send(200, router.render_fleet_metrics().encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/debug/history":
+            qs = parse_qs(self.path.partition("?")[2])
+            window = None
+            raw = (qs.get("window") or [None])[0]
+            if raw is not None:
+                try:
+                    window = float(raw)
+                    if window <= 0:
+                        raise ValueError
+                except ValueError:
+                    self._send_json(400, {"error": f"window must be a "
+                                          f"positive number of seconds, "
+                                          f"got {raw!r}"})
+                    return
+            out = router.fleet_history.window_json(window)
+            out["skewed"] = router.skewed()
+            self._send_json(200, out)
         elif path == "/debug/traces":
             if router.flightrec is None:
                 self._send_json(404, {"error": "tracing disabled "

@@ -151,6 +151,25 @@ class ServeConfig:
     # healthz reports "degraded" for this long after a batcher crash
     # (and while the breaker is not closed) — the replica-gating signal.
     degraded_window_s: float = 30.0
+    # Metric time-series (telemetry/timeseries.py, OBSERVABILITY.md):
+    # a background thread samples the registry every history_interval_s
+    # into a history_window-deep ring — GET /debug/history serves windowed
+    # derived series (rates, delta-p95s), the anomaly sentinels evaluate
+    # over it, and history_path (default <out>/metrics_ts.jsonl when the
+    # server has an out dir) spills every sample with manifest provenance
+    # for tlm top --replay.  0 disables sampling, the endpoint, and the
+    # sentinels together.
+    history_interval_s: float = 1.0
+    history_window: int = 600
+    history_path: Optional[str] = None
+    # Anomaly sentinels (telemetry/anomaly.py): rule-driven detection over
+    # the history — armed after warmup, surfaced as
+    # raft_anomaly_active{rule=} + `anomaly` run-log events + a flight-
+    # recorder dump on first fire.  Requires the history.  The two windows
+    # feed AnomalyConfig; the smoke-scale defaults live there.
+    anomaly: bool = True
+    anomaly_window_s: float = 15.0
+    anomaly_baseline_s: float = 60.0
 
     def __post_init__(self):
         if self.batch_steps is None:
@@ -207,6 +226,17 @@ class ServeConfig:
         if self.retry_backoff_ms < 0 or self.degraded_window_s < 0:
             raise ValueError("retry_backoff_ms and degraded_window_s "
                              "must be >= 0")
+        if self.history_interval_s < 0:
+            raise ValueError(f"history_interval_s must be >= 0 (0 disables "
+                             f"the metric history), got "
+                             f"{self.history_interval_s}")
+        if self.history_interval_s > 0 and self.history_window < 2:
+            raise ValueError("history_window must be >= 2 (rates and "
+                             "percentiles need two samples)")
+        if self.anomaly and self.history_interval_s > 0:
+            from ..telemetry.anomaly import AnomalyConfig
+            AnomalyConfig(window_s=self.anomaly_window_s,
+                          baseline_s=self.anomaly_baseline_s)  # validate
         steps = tuple(sorted(set(self.batch_steps)))
         if not steps or steps[0] < 1:
             raise ValueError(f"batch_steps must be positive, got {steps}")

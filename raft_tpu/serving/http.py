@@ -17,6 +17,16 @@ Endpoints:
   GET  /debug/traces  flight-recorder view: recent + error request traces
                       (optionally ?trace_id=<prefix>; 404 when tracing is
                       off via --trace-sample 0)
+  GET  /debug/history windowed time-series JSON derived from the metric
+                      history ring (?window=<seconds> clips; 404 when the
+                      history is off via --history-interval 0).  Series:
+                      pairs_per_s, p50/p95_ms, occupancy, queue_depth,
+                      burn, sessions, cache-miss rates, anomalies —
+                      OBSERVABILITY.md "Time-series & anomaly detection".
+  POST /debug/profile on-demand jax.profiler capture of the next ?ms=
+                      (default 500, max 60000) milliseconds on the LIVE
+                      replica; single-flight (409 while one runs), 200
+                      returns the XPlane trace_dir written.
 
 Request tracing (OBSERVABILITY.md): every traced request carries a
 ``trace_id`` — minted server-side, or adopted from an ``X-Raft-Trace-Id``
@@ -316,6 +326,11 @@ class _Handler(BaseHTTPRequestHandler):
                     ec = cache.stats.as_dict()
                     ec["dir"] = str(cache.dir)
                     health["engine_cache"] = ec
+                anomaly = getattr(app, "anomaly", None)
+                if anomaly is not None:
+                    # CI smoke gate: a clean run must report {} here; the
+                    # chaos drill asserts a rule appears and then clears
+                    health["anomalies"] = anomaly.active()
                 streams = getattr(app, "streams", None)
                 if streams is not None:
                     health["stream"] = {
@@ -351,6 +366,30 @@ class _Handler(BaseHTTPRequestHandler):
                 "retained_ok": ring, "retained_error": errors,
                 "dumps": app.flightrec.dumps,
                 "traces": traces})
+        elif path == "/debug/history":
+            history = getattr(app, "history", None)
+            if history is None:
+                self._send_json(404, {"error": "metric history disabled "
+                                      "(--history-interval 0)"})
+                return
+            qs = parse_qs(self.path.partition("?")[2])
+            window = None
+            raw = (qs.get("window") or [None])[0]
+            if raw is not None:
+                try:
+                    window = float(raw)
+                    if window <= 0:
+                        raise ValueError
+                except ValueError:
+                    self._send_json(400, {"error": f"window must be a "
+                                          f"positive number of seconds, "
+                                          f"got {raw!r}"})
+                    return
+            out = history.window_json(window)
+            anomaly = getattr(app, "anomaly", None)
+            if anomaly is not None:
+                out["anomalies_active"] = anomaly.active()
+            self._send_json(200, out)
         else:
             self._send_json(404, {"error": f"no handler for {path}"})
 
@@ -376,6 +415,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if path == "/admin/cache/prestage":
             self._post_admin_cache_prestage()
+            return
+        if path == "/debug/profile":
+            self._post_debug_profile()
             return
         if path != "/v1/flow":
             self._send_json(404, {"error": f"no handler for {path}"})
@@ -492,6 +534,36 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(500, {"error": f"prestage failed: {e}"})
             return
         self._send_json(200, {"status": "prestaged", "cache": info})
+
+    def _post_debug_profile(self):
+        """On-demand profiler capture (?ms=, default 500): the handler
+        thread blocks for the capture window while the batcher keeps
+        serving — exactly what gets profiled.  Single-flight process-wide;
+        a concurrent capture gets 409 (the jax profiler is a singleton and
+        two interleaved traces corrupt both XPlanes)."""
+        from ..telemetry.trace import MAX_CAPTURE_MS, CaptureBusy
+        app = self.server_app
+        qs = parse_qs(self.path.partition("?")[2])
+        raw = (qs.get("ms") or ["500"])[0]
+        try:
+            ms = float(raw)
+            if not 0 < ms <= MAX_CAPTURE_MS:
+                raise ValueError
+        except ValueError:
+            self._send_json(400, {"error": f"ms must be in "
+                                  f"(0, {MAX_CAPTURE_MS:g}], got {raw!r}"})
+            return
+        try:
+            info = app.profile_capture(ms)
+        except CaptureBusy as e:
+            self._send_json(409, {"error": str(e)},
+                            headers={"Retry-After": str(max(
+                                1, int(ms / 1000.0 + 1)))})
+            return
+        except Exception as e:
+            self._send_json(500, {"error": f"profiler capture failed: {e}"})
+            return
+        self._send_json(200, {"status": "captured", **info})
 
     def _post_stream(self):
         app = self.server_app

@@ -19,7 +19,8 @@ from typing import Dict
 from ..telemetry.registry import (_Metric,  # noqa: F401 — compat re-export
                                   Counter, Gauge, Histogram, Registry,
                                   DEFAULT_LATENCY_BUCKETS,
-                                  ITERS_USED_BUCKETS, _fmt)
+                                  ITERS_USED_BUCKETS, _fmt,
+                                  register_process_start_time)
 
 
 def make_serving_metrics(registry: Registry, config,
@@ -30,6 +31,7 @@ def make_serving_metrics(registry: Registry, config,
     can never go stale between submissions."""
     occ = tuple(i / 10 for i in range(1, 11))
     batch = tuple(float(s) for s in config.batch_steps)
+    register_process_start_time(registry)
     return {
         "requests": registry.counter(
             "raft_serving_requests_total",

@@ -178,6 +178,35 @@ class FlowServer:
         self.tracer = tlm_spans.Tracer(sample=sconfig.trace_sample,
                                        recorder=self.flightrec,
                                        slo=self.slo)
+        # metric time-series + anomaly sentinels (telemetry/timeseries.py,
+        # telemetry/anomaly.py — OBSERVABILITY.md "Time-series & anomaly
+        # detection"): a background ring of registry snapshots feeding
+        # GET /debug/history, the metrics_ts.jsonl spill, and the rule
+        # sentinels (armed after warmup in start()).  history_interval_s=0
+        # disables all three and keeps /metrics exposition untouched.
+        self.history = None
+        self.anomaly = None
+        self.profile_dir: Optional[str] = None   # POST /debug/profile dest
+        if sconfig.history_interval_s > 0:
+            from ..telemetry.anomaly import AnomalyConfig, AnomalyMonitor
+            from ..telemetry.timeseries import MetricHistory
+            manifest = None
+            if sconfig.history_path:
+                manifest = tlm_events.run_manifest(
+                    config, mode="serve", probe_device=False)
+            self.history = MetricHistory(
+                self.registry, interval_s=sconfig.history_interval_s,
+                window=sconfig.history_window,
+                path=sconfig.history_path, manifest=manifest)
+            if sconfig.anomaly:
+                self.anomaly = AnomalyMonitor(
+                    self.history, self.registry,
+                    run_log=tlm_events.current(),
+                    flightrec=self.flightrec,
+                    config=AnomalyConfig(
+                        window_s=sconfig.anomaly_window_s,
+                        baseline_s=sconfig.anomaly_baseline_s),
+                    log_fn=_log.warning)
         # streaming (/v1/stream): a bounded session store + coordinator,
         # built only when declared (--max-sessions > 0) so a pairwise-only
         # server keeps its exact warmup grid and /metrics exposition
@@ -304,6 +333,21 @@ class FlowServer:
         if run_log is not None:
             run_log.event("serve_weights_reloaded", version=info["version"],
                           tag=info.get("tag"), probed=info.get("probed"))
+        return info
+
+    def profile_capture(self, ms: float) -> dict:
+        """POST /debug/profile?ms=: on-demand ``jax.profiler`` capture of
+        the next ``ms`` milliseconds on a LIVE replica — no restart, no
+        --trace flag decided at boot.  Single-flight (telemetry/trace.py
+        ``capture_profile`` holds a process-wide lock; a concurrent
+        request gets CaptureBusy → 409) and side-effect-free on the
+        engine: profiling must never perturb the warm compile grid, which
+        serve_bench asserts by diffing compile misses across a capture."""
+        from ..telemetry.trace import capture_profile
+        info = capture_profile(self.profile_dir, ms, log_fn=_log.info)
+        run_log = tlm_events.current()
+        if run_log is not None:
+            run_log.event("profile_capture", **info)
         return info
 
     def prestage_cache(self) -> dict:
@@ -436,6 +480,13 @@ class FlowServer:
                 fam["load_seconds"].observe(sec)
         if self._recompile_watch is not None:
             self._recompile_watch.arm()
+        if self.history is not None:
+            self.history.sample()         # t=0 baseline before any traffic
+            self.history.start()
+            if self.anomaly is not None:
+                # arm AFTER warmup: the compile storm and the cold queue
+                # are expected — steady-state invariants start here
+                self.anomaly.arm()
         self.batcher.start()
         self._httpd = make_http_server(self, self.sconfig.host,
                                        self.sconfig.port)
@@ -467,6 +518,8 @@ class FlowServer:
         # SIGTERM/shutdown artifact: the drain is complete, so every
         # in-flight trace has closed — the dump is the final word
         self._flight_dump("shutdown")
+        if self.history is not None:
+            self.history.stop()           # final sample + spill close
         self._trace_window.stop()
         if self._recompile_watch is not None:
             self._recompile_watch.remove()
@@ -598,6 +651,13 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
     if flightrec is None:
         flightrec = os.path.join(getattr(args, "out", None) or ".",
                                  "flightrec.jsonl")
+    # metric history spill: default <out>/metrics_ts.jsonl (the flightrec
+    # pattern); --history-path '' keeps the in-memory ring + endpoint but
+    # skips the file
+    history_path = getattr(args, "history_path", None)
+    if history_path is None:
+        history_path = os.path.join(getattr(args, "out", None) or ".",
+                                    "metrics_ts.jsonl")
     try:
         sconfig = ServeConfig(
             buckets=parse_buckets(args.buckets),
@@ -619,6 +679,12 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
             max_sessions=getattr(args, "max_sessions", 64),
             session_ttl_s=getattr(args, "session_ttl_s", 300.0),
             engine_cache_dir=getattr(args, "engine_cache_dir", None),
+            history_interval_s=getattr(args, "history_interval_s", 1.0),
+            history_window=getattr(args, "history_window", 600),
+            history_path=history_path or None,
+            anomaly=not getattr(args, "no_anomaly", False),
+            anomaly_window_s=getattr(args, "anomaly_window_s", 15.0),
+            anomaly_baseline_s=getattr(args, "anomaly_baseline_s", 60.0),
             # chaos drills: the CLI flag wins, the env var arms CI/ops.
             # breaker knobs use None-checks, not `or`: --breaker-window 0
             # is the documented breaker-off switch and must survive
@@ -639,6 +705,9 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
                         verbose=True,
                         trace_dir=getattr(args, "trace", None),
                         trace_steps=getattr(args, "trace_steps", None) or 4)
+    out = getattr(args, "out", None)
+    if out:
+        server.profile_dir = os.path.join(out, "profiles")
     t0 = time.monotonic()
     server.start()
     print(f"[serve] listening on {server.url}  "
@@ -668,6 +737,13 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
               f"stream={sconfig.slo_stream_ms:.0f}ms  "
               f"flightrec={sconfig.flightrec_path or '(endpoint only)'}  "
               f"GET {server.url}/debug/traces")
+    if server.history is not None:
+        sentinels = ("armed" if server.anomaly is not None else "off")
+        print(f"[serve] history: interval={sconfig.history_interval_s:g}s "
+              f"window={sconfig.history_window}  sentinels={sentinels}  "
+              f"spill={sconfig.history_path or '(ring only)'}  "
+              f"GET {server.url}/debug/history   "
+              f"POST {server.url}/debug/profile?ms=500")
     print(f"[serve] POST {server.url}/v1/flow   "
           f"GET {server.url}/healthz   GET {server.url}/metrics")
 

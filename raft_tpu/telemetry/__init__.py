@@ -18,6 +18,16 @@ Four small, dependency-free layers shared by train, serve, and bench:
 * :mod:`spans` — request-scoped tracing for the serving plane: ID-carrying
   spans with parent links and status, the flight recorder, and SLO burn
   accounting (``tools/tlm.py trace`` renders the waterfalls).
+* :mod:`timeseries` — ``MetricHistory``, the bounded ring of registry
+  snapshots sampled on a background interval, plus the pure delta-window
+  derivations (counter rates, delta-percentiles over cumulative histogram
+  buckets) that turn two snapshots into a dashboard panel, and
+  ``ScrapeHistory`` for per-source (fleet replica) scrape rings.
+* :mod:`anomaly` — rule-driven sentinels evaluated over the history
+  (p95 drift, burn acceleration, occupancy collapse, queue growth,
+  post-warmup miss trickle, restart churn) surfaced as
+  ``raft_anomaly_active{rule=}`` gauges, run-log events, and a
+  flight-recorder dump on first fire.
 
 ``registry`` and ``events`` import no jax at module level (the linter and
 the manifest tooling must run without it); ``trace`` / ``watchdogs``
@@ -25,10 +35,14 @@ import jax lazily inside the functions that need it.
 """
 
 from .registry import (Counter, Gauge, Histogram,  # noqa: F401
-                       Registry, DEFAULT_LATENCY_BUCKETS, default_registry)
+                       Registry, DEFAULT_LATENCY_BUCKETS, default_registry,
+                       register_process_start_time)
 from .events import (RunLog, config_hash, read_events,  # noqa: F401
                      run_manifest, start_run)
 from .log import get_logger  # noqa: F401
 from .trace import TraceWindow, current_stage, stage  # noqa: F401
 from .spans import (FlightRecorder, RequestTrace,  # noqa: F401
                     SLOTracker, Tracer)
+from .timeseries import (MetricHistory, ScrapeHistory,  # noqa: F401
+                         load_metrics_ts)
+from .anomaly import AnomalyConfig, AnomalyMonitor, replica_skew  # noqa: F401

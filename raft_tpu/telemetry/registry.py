@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Dict, Iterable, Optional, Sequence, Tuple
 
 
@@ -228,10 +229,21 @@ class Histogram(_Metric):
         yield f"{family.name}_count{lbl} {total}"
 
     def _snapshot_value(self):
+        """count/sum/mean plus the CUMULATIVE per-bucket counts keyed by
+        their ``le`` bound (the exposition's ``_bucket{le=}`` samples, as
+        JSON) — what the time-series layer diffs between two snapshots to
+        derive windowed p50/p95 (telemetry/timeseries.py
+        ``delta_percentile``)."""
         with self._lock:
             count, s = self._count, self._sum
+            counts = list(self._counts)
+        cum, buckets = 0, {}
+        for bound, c in zip(self._bounds + (math.inf,), counts):
+            cum += c
+            buckets[_fmt(bound)] = cum
         return {"count": count, "sum": round(s, 6),
-                "mean": round(s / count, 6) if count else 0.0}
+                "mean": round(s / count, 6) if count else 0.0,
+                "buckets": buckets}
 
 
 class Registry:
@@ -300,10 +312,32 @@ class Registry:
 
     def snapshot(self) -> dict:
         """{metric name: value} for every family — what the run-event log
-        records at end of run and ``tlm compare`` diffs."""
+        records at end of run and ``tlm compare`` diffs.  Carries one
+        private key, ``_scrape_time`` (unix seconds at sample time), so
+        rate/percentile math over consecutive snapshots has a well-defined
+        denominator (telemetry/timeseries.py); consumers that print or
+        diff skip ``_``-prefixed keys."""
         with self._lock:
             metrics = list(self._metrics.values())
-        return {m.name: m.snapshot() for m in metrics}
+        snap = {m.name: m.snapshot() for m in metrics}
+        snap["_scrape_time"] = time.time()
+        return snap
+
+
+_PROCESS_START = time.time()
+
+
+def register_process_start_time(registry: Registry) -> Gauge:
+    """``raft_process_start_time_seconds`` (the standard Prometheus
+    process-uptime anchor): unix time this PROCESS imported the telemetry
+    layer — constant per process, so ``scrape_time - start_time`` is
+    uptime and counter-rate math can tell a restart from a reset."""
+    g = registry.get_or_gauge(
+        "raft_process_start_time_seconds",
+        "Unix time the process started (Prometheus convention; "
+        "scrape_time - this = process uptime)")
+    g.set(_PROCESS_START)
+    return g
 
 
 # Process-default registry: subsystems without their own Registry (the data

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from typing import Optional
 
 _stack = threading.local()
@@ -102,3 +103,59 @@ class TraceWindow:
             self._tracing = False
             self._done = True
             self._log(f"wrote profiler trace to {self.trace_dir}")
+
+
+class CaptureBusy(RuntimeError):
+    """A profiler capture is already in flight (the profiler is a
+    process-wide singleton — two concurrent start_trace calls corrupt
+    each other's XPlane output).  HTTP maps this to 409."""
+
+
+# jax.profiler.start_trace/stop_trace share one process-global profiler:
+# the on-demand capture endpoint must single-flight across ALL servers in
+# the process (tests run several), not per FlowServer.
+_capture_lock = threading.Lock()
+
+MAX_CAPTURE_MS = 60_000.0
+
+
+def capture_profile(trace_dir: Optional[str], duration_ms: float,
+                    log_fn=None) -> dict:
+    """Time-boxed on-demand ``jax.profiler`` capture: start a trace, sleep
+    ``duration_ms`` while the serving threads keep working, stop, return
+    ``{"trace_dir", "duration_ms", "started"}`` — the TraceWindow
+    semantics keyed by wall time instead of step count, for profiling a
+    LIVE replica (POST /debug/profile) without a restart.
+
+    Single-flight via a process-wide non-blocking lock (:class:`CaptureBusy`
+    when one is already running).  ``trace_dir=None`` allocates a fresh
+    temp dir per capture; each capture lands in a timestamped subdirectory
+    so repeated captures never collide."""
+    if not 0 < duration_ms <= MAX_CAPTURE_MS:
+        raise ValueError(f"duration_ms must be in (0, {MAX_CAPTURE_MS:g}], "
+                         f"got {duration_ms}")
+    if not _capture_lock.acquire(blocking=False):
+        raise CaptureBusy("a profiler capture is already running")
+    try:
+        import os
+        import tempfile
+        started = time.time()
+        if trace_dir is None:
+            dest = tempfile.mkdtemp(prefix="raft-profile-")
+        else:
+            dest = os.path.join(trace_dir, time.strftime(
+                "%Y%m%dT%H%M%S", time.gmtime(started)))
+            os.makedirs(dest, exist_ok=True)
+        import jax
+        jax.profiler.start_trace(dest)
+        try:
+            time.sleep(duration_ms / 1000.0)
+        finally:
+            jax.profiler.stop_trace()
+        if log_fn is not None:
+            log_fn(f"on-demand profiler capture: {duration_ms:g}ms "
+                   f"-> {dest}")
+        return {"trace_dir": dest, "duration_ms": duration_ms,
+                "started": round(started, 3)}
+    finally:
+        _capture_lock.release()

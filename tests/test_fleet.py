@@ -317,6 +317,142 @@ def test_rolling_update_aborts_on_failure_and_clears_drain_flags():
 
 
 # ---------------------------------------------------------------------------
+# scrape history, replica skew, fleet metrics rollup (fake replicas)
+# ---------------------------------------------------------------------------
+
+def _lat_scrape(pairs, b01, b1):
+    """A /metrics-shaped flat dict with ``b01`` observations <= 0.1s and
+    ``b1 - b01`` in (0.1, 1]."""
+    return {"raft_serving_pairs_total": float(pairs),
+            'raft_serving_request_latency_seconds_bucket{le="0.1"}':
+                float(b01),
+            'raft_serving_request_latency_seconds_bucket{le="1"}': float(b1),
+            'raft_serving_request_latency_seconds_bucket{le="+Inf"}':
+                float(b1),
+            "raft_serving_request_latency_seconds_sum": float(b1) * 0.05,
+            "raft_serving_request_latency_seconds_count": float(b1)}
+
+
+def _poll_scrapes(router, manager, scrapes):
+    """Install per-replica scrapes and fire the manager's poll callback
+    the way the poll thread does."""
+    for idx, flat in scrapes.items():
+        rep = manager.get(idx)
+        rep.prom = flat
+        router._replica_polled(rep)
+
+
+def test_router_skew_detection_steering_and_clear():
+    """One replica serving 10x-slower p95s than its siblings is judged
+    skewed (cross-ring replica_skew over the scrape history), _pick
+    steers new work away SOFTLY (still picked when nothing else is
+    routable), and the verdict clears when its latency rejoins the
+    fleet."""
+    config, manager, _ = fake_fleet(3)
+    router = FleetRouter(config, manager)
+    # scrape 1: all counters at zero (the baseline sample)
+    _poll_scrapes(router, manager, {i: _lat_scrape(0, 0, 0)
+                                    for i in range(3)})
+    assert router.skewed() == [] and router.skew_count() == 0
+    # scrape 2: replicas 0/1 fast (all obs <= 0.1s), replica 2 slow
+    _poll_scrapes(router, manager, {0: _lat_scrape(100, 100, 100),
+                                    1: _lat_scrape(100, 100, 100),
+                                    2: _lat_scrape(100, 0, 100)})
+    assert router.skewed() == [2]
+    assert router.skew_count() == 1
+    assert sorted(router.fleet_history.sources()) == ["0", "1", "2"]
+    # soft steering: new picks avoid the skewed replica...
+    picked = set()
+    for _ in range(4):
+        r = router._pick()
+        picked.add(r.idx)
+        router._unpick(r.idx)
+    assert 2 not in picked
+    # ...but a fully-skewed fleet still serves (preference, not outage)
+    for rep in manager.replicas():
+        if rep.idx != 2:
+            rep.state = "dead"
+    assert router._pick().idx == 2
+    router._unpick(2)
+    for rep in manager.replicas():
+        rep.state = "ready"
+    # recovery: replica 2's recent window turns fast -> verdict clears
+    _poll_scrapes(router, manager, {0: _lat_scrape(200, 200, 200),
+                                    1: _lat_scrape(200, 200, 200),
+                                    2: _lat_scrape(200, 200, 200)})
+    assert router.skewed() == []
+    # death: the ring and any verdict are dropped with the replica
+    router._replica_died(manager.get(2))
+    assert "2" not in router.fleet_history.sources()
+
+
+def test_router_skew_needs_three_replicas():
+    config, manager, _ = fake_fleet(2)
+    router = FleetRouter(config, manager)
+    _poll_scrapes(router, manager, {0: _lat_scrape(0, 0, 0),
+                                    1: _lat_scrape(0, 0, 0)})
+    _poll_scrapes(router, manager, {0: _lat_scrape(100, 100, 100),
+                                    1: _lat_scrape(100, 0, 100)})
+    # with two replicas either could be the outlier: never judge
+    assert router.skewed() == []
+
+
+def test_render_fleet_metrics_relabels_and_rolls_up():
+    config, manager, _ = fake_fleet(3)
+    router = FleetRouter(config, manager)
+    manager.get(0).prom = {"raft_serving_pairs_total": 300.0,
+                           'raft_serving_requests_total{status="ok"}': 30.0,
+                           "raft_serving_queue_depth": 2.0}
+    manager.get(1).prom = {"raft_serving_pairs_total": 100.0,
+                           'raft_serving_requests_total{status="ok"}': 10.0,
+                           "raft_serving_queue_depth": 1.0}
+    manager.get(2).prom = {"raft_serving_pairs_total": 999.0}
+    manager.get(2).state = "dead"           # non-routable: excluded
+    text = router.render_fleet_metrics()
+    assert 'raft_serving_pairs_total{replica="0"} 300' in text
+    assert 'raft_serving_pairs_total{replica="1"} 100' in text
+    assert 'raft_serving_pairs_total{replica="all"} 400' in text
+    # existing labels merge after the replica label
+    assert ('raft_serving_requests_total{replica="0",status="ok"} 30'
+            in text)
+    assert ('raft_serving_requests_total{replica="all",status="ok"} 40'
+            in text)
+    assert 'replica="2"' not in text
+    assert text.endswith("\n")
+    # the round-trip through the fleet parser keeps the values
+    parsed = parse_prom_text(text)
+    assert parsed['raft_serving_queue_depth{replica="all"}'] == 3.0
+
+
+def test_fleet_signals_count_anomaly_sentinels():
+    config, manager, _ = fake_fleet(2)
+    manager.get(0).prom = {'raft_anomaly_active{rule="p95_drift"}': 1.0,
+                           'raft_anomaly_active{rule="queue_growth"}': 0.0,
+                           "raft_serving_queue_limit": 16.0}
+    manager.get(1).prom = {'raft_anomaly_active{rule="p95_drift"}': 0.0,
+                           "raft_serving_queue_limit": 16.0}
+    sig = fleet_signals(manager, {})
+    assert sig["anomaly"] == 1.0
+    manager.get(0).prom['raft_anomaly_active{rule="p95_drift"}'] = 0.0
+    assert fleet_signals(manager, {})["anomaly"] == 0.0
+
+
+def test_autoscaler_anomaly_is_pressure_and_blocks_scale_down():
+    # a firing sentinel anywhere in the fleet scales up...
+    anomalous = dict(CALM, anomaly=1.0)
+    scaler, manager, _ = _mk_autoscaler([anomalous, anomalous])
+    assert scaler.step() is None
+    assert scaler.step() == "up"
+    # ...and an otherwise-calm fleet with a sentinel firing never
+    # scales down (calm requires anomaly == 0)
+    scaler2, manager2, _ = _mk_autoscaler([anomalous] * 5, replicas=2)
+    manager2.scale_to(2)
+    for _ in range(5):
+        scaler2.step()
+    assert manager2.desired > 1
+
+
+# ---------------------------------------------------------------------------
 # live fleet: two real FlowServers behind a real router
 # ---------------------------------------------------------------------------
 
@@ -502,6 +638,37 @@ def test_fleet_hot_swap_rejects_mismatched_tree(live_fleet):
     assert [r["status"] for r in result["replicas"][1:]] == ["skipped"]
     for i, server in servers.items():
         assert server.engine.weight_info()["version"] == versions0[i]
+
+
+def test_fleet_metrics_and_history_endpoints(live_fleet):
+    """GET /metrics/fleet re-labels every replica's cached scrape with
+    replica=<idx> plus replica="all" rollups; GET /debug/history serves
+    the per-source derived series + the skew verdict list.  Both are
+    built from the manager's cached polls — no replica round-trips at
+    request time."""
+    router, manager, servers, _ = live_fleet
+    manager.poll_once()                     # fresh scrape -> on_poll ingest
+    manager.poll_once()                     # second sample: rates derivable
+    with urllib.request.urlopen(router.url + "/metrics/fleet") as r:
+        assert r.status == 200
+        assert "text/plain" in r.headers["Content-Type"]
+        text = r.read().decode()
+    for rep in ("0", "1", "all"):
+        assert f'raft_serving_queue_limit{{replica="{rep}"}}' in text, rep
+    parsed = parse_prom_text(text)
+    assert parsed['raft_serving_queue_limit{replica="all"}'] == \
+        parsed['raft_serving_queue_limit{replica="0"}'] \
+        + parsed['raft_serving_queue_limit{replica="1"}']
+    with urllib.request.urlopen(router.url + "/debug/history") as r:
+        body = json.loads(r.read())
+    assert set(body["sources"]) == {"0", "1"}
+    assert body["skewed"] == []             # two healthy replicas
+    series = body["sources"]["0"]
+    assert "pairs_per_s" in series and "p95_ms" in series
+    assert len(series["t"]) >= 1            # two ingests -> >= 1 point
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(router.url + "/debug/history?window=junk")
+    assert ei.value.code == 400
 
 
 def test_fleet_kill_migrates_sessions_with_pairwise_flow(live_fleet):

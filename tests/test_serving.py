@@ -1222,3 +1222,123 @@ def test_serve_bench_importable_and_parses_prom():
         '# HELP x y\nfoo 3\nbar{a="b"} 2.5\nbaz_bucket{le="+Inf"} 7\n')
     assert prom == {"foo": 3.0, 'bar{a="b"}': 2.5,
                     'baz_bucket{le="+Inf"}': 7.0}
+
+
+# ------------------------- metric history + profiler capture endpoints ----
+
+def test_debug_history_endpoint_serves_derived_series():
+    """GET /debug/history returns the derived columnar series (all the
+    DEFAULT_PANELS keys, N-1 points for N retained samples), honors
+    ?window= clipping, 400s malformed windows, and healthz carries the
+    sentinel verdict map."""
+    from raft_tpu.telemetry.timeseries import DEFAULT_PANELS
+
+    eng = StubEngine()
+    sconfig = ServeConfig(buckets=((32, 48),), max_batch=2, max_wait_ms=5.0,
+                          port=0, history_interval_s=0.05,
+                          history_window=100, anomaly_window_s=0.5,
+                          anomaly_baseline_s=2.0)
+    server = FlowServer(None, None, sconfig, engine=eng)
+    server.start()
+    try:
+        im = np.zeros((32, 48, 3)).tolist()
+        req = urllib.request.Request(
+            server.url + "/v1/flow",
+            data=json.dumps({"image1": im, "image2": im}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req).read()
+        body = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(server.url + "/debug/history") as r:
+                assert r.status == 200
+                body = json.loads(r.read())
+            if body["retained"] >= 3:
+                break
+            time.sleep(0.05)
+        assert body["retained"] >= 3, body
+        assert body["interval_s"] == 0.05
+        series = body["series"]
+        assert set(series) == {"t"} | {n for n, *_ in DEFAULT_PANELS}
+        assert len(series["t"]) == body["retained"] - 1
+        assert len(series["p95_ms"]) == len(series["t"])
+        # a clean stub server fires nothing (the acceptance criterion's
+        # zero-anomalies-when-clean half, at unit scale)
+        assert body["anomalies_active"] == {}
+        with urllib.request.urlopen(
+                server.url + "/debug/history?window=0.01") as r:
+            clipped = json.loads(r.read())
+        assert clipped["retained"] <= 2        # 10ms window, 50ms interval
+        for bad in ("?window=nope", "?window=-3", "?window=0"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(server.url + "/debug/history" + bad)
+            assert ei.value.code == 400, bad
+        with urllib.request.urlopen(server.url + "/healthz") as r:
+            h = json.loads(r.read())
+        assert h["anomalies"] == {}
+        # the sentinel gauges are pre-created: exposition shows every rule
+        with urllib.request.urlopen(server.url + "/metrics") as r:
+            text = r.read().decode()
+        assert 'raft_anomaly_active{rule="p95_drift"} 0' in text
+        assert 'raft_anomaly_fires_total{rule="queue_growth"} 0' in text
+    finally:
+        server.stop()
+
+
+def test_debug_history_404_when_disabled():
+    eng = StubEngine()
+    sconfig = ServeConfig(buckets=((32, 48),), port=0,
+                          history_interval_s=0.0)
+    server = FlowServer(None, None, sconfig, engine=eng)
+    server.start()
+    try:
+        assert server.history is None and server.anomaly is None
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(server.url + "/debug/history")
+        assert ei.value.code == 404
+    finally:
+        server.stop()
+
+
+def test_debug_profile_validation_busy_and_capture(tmp_path):
+    """POST /debug/profile: 400 on malformed/over-limit ms, 409 (with
+    Retry-After) while another capture holds the process-wide profiler,
+    200 + an on-disk XPlane tree for a real capture."""
+    from pathlib import Path
+
+    from raft_tpu.telemetry import trace as tlm_trace
+
+    eng = StubEngine()
+    sconfig = ServeConfig(buckets=((32, 48),), port=0,
+                          history_interval_s=0.0)
+    server = FlowServer(None, None, sconfig, engine=eng)
+    server.profile_dir = str(tmp_path / "profiles")
+    server.start()
+    try:
+        def post(qs):
+            return urllib.request.Request(
+                server.url + "/debug/profile" + qs, data=b"", method="POST")
+
+        for bad in ("?ms=0", "?ms=-5", "?ms=abc", "?ms=999999999"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(post(bad))
+            assert ei.value.code == 400, bad
+        assert tlm_trace._capture_lock.acquire(timeout=5)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(post("?ms=50"))
+            assert ei.value.code == 409
+            assert int(ei.value.headers["Retry-After"]) >= 1
+        finally:
+            tlm_trace._capture_lock.release()
+        with urllib.request.urlopen(post("?ms=50")) as r:
+            info = json.loads(r.read())
+        assert info["status"] == "captured"
+        assert info["duration_ms"] == 50.0
+        dest = Path(info["trace_dir"])
+        assert dest.is_dir()
+        assert str(dest).startswith(str(tmp_path))
+        assert list(dest.rglob("*.xplane.pb")), \
+            "capture produced no XPlane file"
+    finally:
+        server.stop()

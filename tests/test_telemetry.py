@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import importlib.util
 import json
+import re
 import subprocess
 import sys
 from pathlib import Path
@@ -52,8 +53,15 @@ def test_registry_snapshot_plain_and_labeled():
     snap = reg.snapshot()
     assert snap["jobs_total"] == 3.0
     assert snap["depth"] == 2.5
-    assert snap["lat"] == {"count": 2, "sum": 5.05, "mean": 2.525}
+    # histogram snapshots carry the cumulative bucket counts (keyed by
+    # their le bound) so the time-series layer can diff two snapshots
+    # into windowed percentiles (telemetry/timeseries.py)
+    assert snap["lat"] == {"count": 2, "sum": 5.05, "mean": 2.525,
+                           "buckets": {"0.1": 1, "1": 1, "+Inf": 2}}
     assert snap["by_status"] == {"ok": 2.0, "shed": 1.0}
+    # the scrape timestamp makes rate math well-defined between snapshots;
+    # private (underscore) keys are skipped by printing/diffing consumers
+    assert isinstance(snap["_scrape_time"], float)
 
 
 def test_default_registry_is_shared_and_get_or_create_works():
@@ -618,3 +626,134 @@ def test_tlm_cli_roundtrip(tmp_path):
          str(a), "-n", "2"], capture_output=True, text=True)
     assert out.returncode == 0
     assert "run_end" in out.stdout
+
+
+# ----------------------------------------------------------- tlm top -----
+
+def test_tlm_sparkline_scaling_and_gaps():
+    tlm = _load_tlm()
+    assert tlm.sparkline([]) == ""
+    assert tlm.sparkline([None, None]) == "  "     # all-gap, width kept
+    line = tlm.sparkline([0.0, None, 10.0])
+    assert line[0] == tlm.SPARK_CHARS[0]
+    assert line[1] == " "                          # None is a gap, not a 0
+    assert line[2] == tlm.SPARK_CHARS[-1]
+    assert len(tlm.sparkline(list(range(100)), width=40)) == 40
+    # constant series renders (span-0 guard), at the low block
+    assert set(tlm.sparkline([3.0, 3.0, 3.0])) == {tlm.SPARK_CHARS[0]}
+
+
+def test_tlm_top_frame_replica_and_fleet_forms():
+    tlm = _load_tlm()
+    series = {"t": [1.0, 2.0], "pairs_per_s": [5.0, 7.0],
+              "p95_ms": [None, None]}
+    clean = {"interval_s": 1.0, "retained": 3, "span_s": 2.0,
+             "series": series, "anomalies_active": {}}
+    out = "\n".join(tlm.top_frame(clean, "replica"))
+    assert "pairs_per_s" in out
+    assert re.search(r"pairs_per_s\s+7\b", out)
+    assert "anomalies: none active" in out
+    assert "—" in out                              # all-None series last value
+    firing = dict(clean, anomalies_active={"p95_drift": "p95 900ms > 2x"})
+    out = "\n".join(tlm.top_frame(firing, "replica"))
+    assert "ANOMALY p95_drift: p95 900ms > 2x" in out
+    # fleet-router form: numeric source order, skew tag on the verdict
+    fleet = {"sources": {"0": series, "10": series, "2": series},
+             "skewed": [2]}
+    lines = tlm.top_frame(fleet, "router")
+    order = [ln for ln in lines if ln.startswith("  replica ")]
+    assert [ln.split()[1] for ln in order] == ["0", "2", "10"]
+    assert "SKEWED" in order[1] and "SKEWED" not in order[0]
+    assert tlm.top_frame({"sources": {}}, "router")[-1] \
+        == "  (no replica scrapes ingested yet)"
+
+
+def _write_spill(path, t0, n, rate, manifest=None):
+    """n samples, 10s apart, pairs counter advancing ``rate``/s."""
+    recs = []
+    if manifest:
+        recs.append({"kind": "manifest", **manifest})
+    for i in range(n):
+        t = t0 + 10.0 * i
+        recs.append({"kind": "sample", "t": t,
+                     "snap": {"_scrape_time": t,
+                              "raft_serving_pairs_total": rate * 10.0 * i}})
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+
+
+def test_tlm_top_replay_file_dir_and_window(tmp_path):
+    tlm = _load_tlm()
+    spill = tmp_path / "metrics_ts.jsonl"
+    _write_spill(spill, 100.0, 4, rate=7.0, manifest={"mode": "serve"})
+    payload = tlm._replay_payload(str(spill))
+    assert payload["retained"] == 4
+    assert payload["interval_s"] == 10.0
+    assert payload["series"]["pairs_per_s"] == [7.0, 7.0, 7.0]
+    assert payload["manifest"]["mode"] == "serve"
+    # window clips to the trailing seconds of the spill
+    assert tlm._replay_payload(str(spill), window=15.0)["retained"] == 2
+    out = "\n".join(tlm.top_lines(str(spill)))
+    assert "pairs_per_s" in out and "(replay)" in out
+    # a run dir with ONE spill replays as that replica
+    assert tlm._replay_payload(str(tmp_path))["retained"] == 4
+    # a fleet out-dir (replica-N subdirs) merges as sources
+    fleet = tmp_path / "fleet"
+    for i in range(2):
+        sub = fleet / f"replica-{i}"
+        sub.mkdir(parents=True)
+        _write_spill(sub / "metrics_ts.jsonl", 100.0, 3, rate=float(i + 1))
+    payload = tlm._replay_payload(str(fleet))
+    assert set(payload["sources"]) == {"replica-0", "replica-1"}
+    assert payload["sources"]["replica-1"]["pairs_per_s"] == [2.0, 2.0]
+    out = "\n".join(tlm.top_lines(str(fleet)))
+    assert "replica replica-0" in out and "replica replica-1" in out
+    with pytest.raises(FileNotFoundError):
+        tlm._replay_payload(str(tmp_path / "empty-nothing"))
+
+
+def test_tlm_top_cli_once_and_bad_target(tmp_path):
+    spill = tmp_path / "metrics_ts.jsonl"
+    _write_spill(spill, 100.0, 3, rate=4.0)
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "tlm.py"), "top",
+         str(spill), "--once"], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "tlm top" in out.stdout and "pairs_per_s" in out.stdout
+    # a missing path / unreachable URL is rc=2 with a message, not a crash
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "tlm.py"), "top",
+         str(tmp_path / "nope"), "--once"], capture_output=True, text=True)
+    assert out.returncode == 2
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "tlm.py"), "top",
+         "http://127.0.0.1:9", "--once"], capture_output=True, text=True)
+    assert out.returncode == 2
+
+
+def test_tlm_summary_highlights_fleet_cache_and_anomalies(tmp_path):
+    tlm = _load_tlm()
+    d = tmp_path / "run"
+    d.mkdir()
+    man = run_manifest(mode="serve", probe_device=False)
+    lines = [
+        {"t": 1.0, "event": "manifest", **man},
+        {"t": 2.0, "event": "anomaly", "rule": "p95_drift", "edge": "fire",
+         "reason": "p95 900ms > 2x baseline"},
+        {"t": 3.0, "event": "anomaly", "rule": "p95_drift", "edge": "clear"},
+        {"t": 4.0, "event": "run_end", "final_step": 0,
+         "metrics": {"raft_fleet_replicas_ready": 3.0,
+                     "raft_fleet_replica_skew": 1.0,
+                     "raft_engine_cache_hits_total": 7.0,
+                     "raft_engine_cache_misses_total": 2.0,
+                     "raft_anomaly_fires_total": {"p95_drift": 1.0,
+                                                  "queue_growth": 0.0},
+                     "_scrape_time": 123.0}},
+    ]
+    (d / "events.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in lines))
+    out = "\n".join(tlm.summary_lines(d))
+    assert "ANOMALIES: 1 sentinel fire(s)" in out and "p95_drift" in out
+    assert "engine cache" in out and "7" in out
+    assert "fleet:" in out and "replicas_ready" in out
+    assert "anomaly sentinels fired: p95_drift x1" in out
+    assert "_scrape_time" not in out               # private keys stay hidden

@@ -15,6 +15,19 @@ Usage:
     python tools/tlm.py summary PATH
     python tools/tlm.py compare A B
     python tools/tlm.py trace PATH [TRACE_ID]
+    python tools/tlm.py top URL_OR_PATH [--window S] [--interval S] [--once]
+
+``top`` is the live terminal dashboard over the time-series plane
+(OBSERVABILITY.md "Time-series & anomaly detection"): pointed at a
+serving URL it polls ``GET /debug/history`` — a replica shows its
+derived panels (pairs/s, p50/p95, occupancy, queue, burn, cache-miss
+rates) as sparklines plus any firing anomaly sentinels; a fleet router
+shows one block per replica plus the skew-drained list.  Pointed at a
+``metrics_ts.jsonl`` spill (or a run dir holding one — fleet dirs show
+every replica) it REPLAYS the run offline through the exact same
+derivation path, no server required.  ``--once`` prints a single frame
+and exits (CI / piping); without it the screen redraws every
+``--interval`` seconds until Ctrl-C.
 
 ``summary`` prints the manifest (provenance: git sha, jax version, device,
 config hash), per-event-kind counts, and whatever run result the log holds
@@ -122,6 +135,19 @@ def _fmt_val(v) -> str:
     return str(v)
 
 
+def _fmt_metric(v) -> str:
+    """Compact one-line rendering for registry-snapshot values: histogram
+    dicts as count/mean (the bucket map is for derivation, not reading),
+    labeled families as k=v pairs, scalars via :func:`_fmt_val`."""
+    if isinstance(v, dict):
+        if "count" in v:
+            return f"count {v.get('count')}  mean {_fmt_val(v.get('mean', 0.0))}"
+        pairs = [f"{k}={_fmt_val(sv)}" for k, sv in sorted(v.items())
+                 if isinstance(sv, (int, float))]
+        return "  ".join(pairs) if pairs else str(v)
+    return _fmt_val(v)
+
+
 def summary_lines(path) -> List[str]:
     records = load_records(path)
     out = [f"== {path} ({len(records)} record(s))"]
@@ -187,11 +213,22 @@ def summary_lines(path) -> List[str]:
         out.append(f"  steps {first['step']} -> {last['step']}: " + "  ".join(
             f"{k} {_fmt_val(first.get(k))} -> {_fmt_val(last.get(k))}"
             for k in keys))
+    if kinds.get("anomaly"):
+        fires = sum(1 for r in records if r.get("event") == "anomaly"
+                    and r.get("edge") == "fire")
+        rules = sorted({r.get("rule") for r in records
+                        if r.get("event") == "anomaly"
+                        and r.get("edge") == "fire"})
+        out.append(f"  ANOMALIES: {fires} sentinel fire(s) "
+                   f"[{', '.join(str(r) for r in rules)}] — see `anomaly` "
+                   f"events for reasons; /debug/history for the window")
     for rec in records:
         if rec.get("event") == "run_end" and isinstance(rec.get("metrics"),
                                                         dict):
             for name, val in sorted(rec["metrics"].items()):
-                out.append(f"  {name:<32} {_fmt_val(val)}")
+                if name.startswith("_"):
+                    continue          # private snapshot fields (_scrape_time)
+                out.append(f"  {name:<32} {_fmt_metric(val)}")
             wait = rec["metrics"].get("raft_data_wait_seconds")
             if isinstance(wait, dict) and wait.get("count"):
                 out.append(
@@ -226,6 +263,31 @@ def summary_lines(path) -> List[str]:
                     f"  checkpoint writer: {cw['count']} write(s), mean "
                     f"{cw['mean'] * 1000:.0f} ms each kept off the step "
                     f"path (async; --sync-ckpt restores inline saves)")
+            ec_hits = rec["metrics"].get("raft_engine_cache_hits_total")
+            ec_miss = rec["metrics"].get("raft_engine_cache_misses_total")
+            if isinstance(ec_hits, (int, float)) \
+                    or isinstance(ec_miss, (int, float)):
+                out.append(
+                    f"  engine cache: {int(ec_hits or 0)} AOT deserialize "
+                    f"hit(s), {int(ec_miss or 0)} compile miss(es) — a "
+                    f"warm cache boots compile-free "
+                    f"(--engine-cache-dir, SERVING.md)")
+            fleet_nums = {k[len("raft_fleet_"):]: v
+                          for k, v in rec["metrics"].items()
+                          if k.startswith("raft_fleet_")
+                          and isinstance(v, (int, float)) and v}
+            if fleet_nums:
+                out.append("  fleet: " + "  ".join(
+                    f"{k}={_fmt_val(v)}"
+                    for k, v in sorted(fleet_nums.items())))
+            af = rec["metrics"].get("raft_anomaly_fires_total")
+            if isinstance(af, dict):
+                fired = {k: v for k, v in af.items()
+                         if isinstance(v, (int, float)) and v}
+                if fired:
+                    out.append("  anomaly sentinels fired: " + ", ".join(
+                        f"{k} x{int(v)}"
+                        for k, v in sorted(fired.items())))
         if rec.get("event") == "nonfinite":
             out.append(f"  NONFINITE at stage {rec.get('stage')!r} "
                        f"({rec.get('bad_values')} value(s))")
@@ -246,6 +308,20 @@ def summary_lines(path) -> List[str]:
                         f"{row['pairs_per_sec']} pairs/s  "
                         f"mean_iters {row['mean_iters']} "
                         f"(fixed {conv.get('baseline_mean_iters')})")
+            quant = rec.get("quant")
+            if isinstance(quant, dict):
+                for row in quant.get("rows", []):
+                    if "pairs_per_sec" in row:
+                        out.append(
+                            f"    quant:{row['quant']}: "
+                            f"{row['pairs_per_sec']} pairs/s  encoder HBM "
+                            f"x{row.get('encoder_hbm_ratio')} smaller")
+                    else:
+                        out.append(
+                            f"    quant:{row['quant']}: "
+                            f"x{row.get('compression')} slot-row "
+                            f"compression  max_rel_err "
+                            f"{row.get('max_rel_err')}")
     return out
 
 
@@ -425,6 +501,8 @@ def _final_numbers(records: List[dict]) -> dict:
         if rec.get("event") == "run_end" and isinstance(rec.get("metrics"),
                                                         dict):
             for name, val in rec["metrics"].items():
+                if name.startswith("_"):
+                    continue          # private snapshot fields (_scrape_time)
                 if isinstance(val, (int, float)):
                     out[name] = val
                 elif isinstance(val, dict):
@@ -437,6 +515,143 @@ def _final_numbers(records: List[dict]) -> dict:
                 if isinstance(rec.get(k), (int, float)):
                     out[k] = rec[k]
     return out
+
+
+# ------------------------------------------------------------- tlm top --
+
+SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(vals, width: int = 40) -> str:
+    """Unicode sparkline of the trailing ``width`` points, scaled to the
+    visible min..max; a None point renders as a gap (a quiet interval has
+    no value, not a zero value)."""
+    tail = list(vals)[-width:]
+    nums = [v for v in tail if isinstance(v, (int, float))]
+    if not nums:
+        return " " * len(tail)
+    lo, hi = min(nums), max(nums)
+    span = (hi - lo) or 1.0
+    out = []
+    for v in tail:
+        if not isinstance(v, (int, float)):
+            out.append(" ")
+        else:
+            out.append(SPARK_CHARS[int((v - lo) / span
+                                       * (len(SPARK_CHARS) - 1))])
+    return "".join(out)
+
+
+def _last_value(vals):
+    for v in reversed(vals):
+        if v is not None:
+            return v
+    return None
+
+
+def _panel_order() -> List[str]:
+    from raft_tpu.telemetry.timeseries import DEFAULT_PANELS
+    return [name for name, *_ in DEFAULT_PANELS]
+
+
+def _series_block(series: dict, width: int = 40) -> List[str]:
+    """Sparkline rows for one columnar series dict ({'t': [...], name:
+    [...]}), in the DEFAULT_PANELS order (unknown names last)."""
+    order = _panel_order()
+    names = [n for n in series if n != "t"]
+    names.sort(key=lambda n: (order.index(n) if n in order else len(order),
+                              n))
+    out = []
+    for name in names:
+        vals = series.get(name, [])
+        last = _last_value(vals)
+        disp = "—" if last is None else _fmt_val(float(last))
+        out.append(f"    {name:<24} {disp:>10}  {sparkline(vals, width)}")
+    return out
+
+
+def top_frame(payload: dict, source: str, width: int = 40) -> List[str]:
+    """One dashboard frame from a ``/debug/history`` payload — the
+    replica form ({"series": ...} + anomalies_active) or the fleet-router
+    form ({"sources": {idx: series}} + skewed) — or a replay-derived
+    payload of either shape."""
+    out = [f"== tlm top — {source}"]
+    if "series" in payload:
+        out.append(f"  interval {payload.get('interval_s', '?')}s   "
+                   f"retained {payload.get('retained', '?')} sample(s)   "
+                   f"span {payload.get('span_s', '?')}s")
+        out.extend(_series_block(payload["series"], width))
+        active = payload.get("anomalies_active")
+        if active:
+            for rule, reason in sorted(active.items()):
+                out.append(f"  ANOMALY {rule}: {reason}")
+        elif "anomalies_active" in payload:
+            out.append("  anomalies: none active")
+    if "sources" in payload:
+        skewed = {str(s) for s in payload.get("skewed", [])}
+        def _src_key(item):
+            src = item[0]
+            return (0, int(src)) if src.isdigit() else (1, src)
+        for src, series in sorted(payload["sources"].items(), key=_src_key):
+            tag = "  [SKEWED — picks steered away]" if src in skewed else ""
+            out.append(f"  replica {src}{tag}")
+            out.extend(_series_block(series, width))
+        if not payload["sources"]:
+            out.append("  (no replica scrapes ingested yet)")
+    return out
+
+
+def _replay_payload(path, window: Optional[float] = None) -> dict:
+    """Rebuild a /debug/history-shaped payload from ``metrics_ts.jsonl``
+    spills: a file replays as one replica's series; a run dir merges
+    every ``*/metrics_ts.jsonl`` below it as fleet sources (replica-N
+    subdir name = source)."""
+    from raft_tpu.telemetry.timeseries import derive_series, load_metrics_ts
+
+    def clipped(samples):
+        if window is not None and samples:
+            cutoff = samples[-1]["t"] - window
+            samples = [s for s in samples if s["t"] >= cutoff]
+        return samples
+
+    p = Path(path)
+    if p.is_file():
+        manifest, samples = load_metrics_ts(p)
+        samples = clipped(samples)
+        span = (samples[-1]["t"] - samples[0]["t"]
+                if len(samples) > 1 else 0.0)
+        payload = {"retained": len(samples), "span_s": round(span, 3),
+                   "interval_s": round(span / (len(samples) - 1), 3)
+                   if len(samples) > 1 else "?",
+                   "series": derive_series(samples)}
+        if manifest:
+            payload["manifest"] = manifest
+        return payload
+    files = [q for q in [p / "metrics_ts.jsonl"]
+             + sorted(p.glob("*/metrics_ts.jsonl")) if q.exists()]
+    if not files:
+        raise FileNotFoundError(f"{path}: no metrics_ts.jsonl inside")
+    if len(files) == 1:
+        return _replay_payload(files[0], window)
+    return {"sources": {
+        q.parent.name: derive_series(clipped(load_metrics_ts(q)[1]))
+        for q in files}}
+
+
+def top_lines(target: str, window: Optional[float] = None,
+              width: int = 40) -> List[str]:
+    """One ``tlm top`` frame: live (``http(s)://`` target → GET
+    /debug/history) or replay (a metrics_ts.jsonl / run dir)."""
+    if target.startswith(("http://", "https://")):
+        import urllib.request
+        url = target.rstrip("/") + "/debug/history"
+        if window is not None:
+            url += f"?window={window:g}"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            payload = json.loads(r.read())
+        return top_frame(payload, target, width)
+    return top_frame(_replay_payload(target, window),
+                     f"{target} (replay)", width)
 
 
 def compare_lines(path_a, path_b) -> Tuple[List[str], bool]:
@@ -494,6 +709,16 @@ def main(argv=None) -> int:
                                  "run dir holding one")
     pr.add_argument("trace_id", nargs="?", default=None,
                     help="trace id (prefix ok); omit to list")
+    pp = sub.add_parser("top", help="live dashboard over /debug/history "
+                                    "(URL) or replay a metrics_ts.jsonl")
+    pp.add_argument("path", help="serving/router URL (http://host:port) "
+                                 "or a metrics_ts.jsonl / run dir")
+    pp.add_argument("--window", type=float, default=None,
+                    help="trailing seconds to show (default: whole ring)")
+    pp.add_argument("--interval", type=float, default=2.0,
+                    help="redraw period for live mode (seconds)")
+    pp.add_argument("--once", action="store_true",
+                    help="print one frame and exit (CI / piping)")
     args = p.parse_args(argv)
 
     try:
@@ -517,15 +742,30 @@ def main(argv=None) -> int:
                 return 1
             for rec in hits:
                 print("\n".join(render_trace(rec)))
+        elif args.cmd == "top":
+            import time as _time
+            try:
+                while True:
+                    lines = top_lines(args.path, args.window)
+                    if args.once:
+                        print("\n".join(lines))
+                        break
+                    # full-screen redraw (clear + home), the classic top(1)
+                    sys.stdout.write("\x1b[2J\x1b[H" + "\n".join(lines)
+                                     + "\n")
+                    sys.stdout.flush()
+                    _time.sleep(args.interval)
+            except KeyboardInterrupt:
+                return 0
         else:
             lines, comparable = compare_lines(args.a, args.b)
             print("\n".join(lines))
             return 0 if comparable else 1
-    except FileNotFoundError as e:
-        print(f"tlm: {e}", file=sys.stderr)
-        return 2
     except BrokenPipeError:       # `tlm trace ... | head` is a normal use
         return 0
+    except OSError as e:          # missing file, or `top` URL unreachable
+        print(f"tlm: {e}", file=sys.stderr)
+        return 2
     return 0
 
 
