@@ -339,6 +339,23 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="serve mode: streaming sessions idle longer than "
                         "T seconds are reaped; advancing a reaped id is a "
                         "404 (the client reopens)")
+    p.add_argument("--ragged", action="store_true",
+                   help="serve mode: ragged mixed-resolution batching "
+                        "(SERVING.md 'Ragged serving') — every request is "
+                        "zero-embedded corner-anchored into the max "
+                        "declared bucket and carries per-row live sizes, "
+                        "so ONE executable per (kind, batch-step) serves "
+                        "every bucket and requests of different "
+                        "resolutions coalesce into one device batch "
+                        "(requires corr_impl=pallas or the XLA ragged "
+                        "reference; single-device only)")
+    p.add_argument("--ragged-batch-pixels", type=int, default=0,
+                   metavar="N",
+                   help="serve mode (with --ragged): cap one device "
+                        "batch's LIVE-pixel footprint — a popped run is "
+                        "chunked so co-batched live pixels stay under N "
+                        "(keeps one large frame from starving a group of "
+                        "small ones).  0 = unbounded")
     # chaos + self-healing (SERVING.md "Failure modes & degradation
     # ladder"): fault injection is a first-class drill surface, and the
     # breaker/supervisor knobs gate what /healthz reports

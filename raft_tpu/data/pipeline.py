@@ -76,6 +76,23 @@ def pad_to_shape(image: np.ndarray, target_hw: Tuple[int, int],
     return _apply_pads(image, th - h, tw - w, mode)
 
 
+def embed_to_shape(arr: np.ndarray,
+                   target_hw: Tuple[int, int]) -> np.ndarray:
+    """Corner-anchor [..., H, W, C] into an exact (H, W) max box by
+    ZERO-padding bottom/right only — the ragged-serving embed.  Unlike
+    :func:`pad_to_shape` the content is not centered and not replicated:
+    the ragged model path needs the live crop at (0, 0) with deterministic
+    zeros outside (models/raft.py re-masks in-graph, so the zeros are a
+    contract, not a numerics requirement).  Invert by slicing
+    ``out[..., :h, :w, :]``."""
+    h, w = arr.shape[-3], arr.shape[-2]
+    th, tw = target_hw
+    if h > th or w > tw:
+        raise ValueError(f"image ({h}, {w}) exceeds embed target ({th}, {tw})")
+    width = [(0, 0)] * (arr.ndim - 3) + [(0, th - h), (0, tw - w), (0, 0)]
+    return np.pad(arr, width)
+
+
 def unpad(arr: np.ndarray, pads: Tuple[int, int, int, int]) -> np.ndarray:
     t, b, l, r = pads
     h, w = arr.shape[-3], arr.shape[-2]

@@ -99,7 +99,15 @@ def enumerate_warmup_grid(config, sconfig, stream: Optional[bool] = None,
     if chaos is None:
         chaos = sconfig.chaos is not None
     policy = resolved_policy(config, sconfig)
-    grid = [(h, w, b, "pair") for (h, w) in sconfig.buckets
+    # ragged mixed-resolution serving (SERVING.md "Ragged serving"): the
+    # bucket axis of the grid COLLAPSES to the single max-box arena —
+    # per-row live sizes are a runtime argument, so one executable per
+    # (kind, batch-step, policy) serves every declared resolution and the
+    # compile surface shrinks from O(buckets x steps) to O(steps).
+    buckets = ((tuple(sconfig.max_box),)
+               if getattr(sconfig, "ragged", False)
+               else tuple(tuple(b) for b in sconfig.buckets))
+    grid = [(h, w, b, "pair") for (h, w) in buckets
             for b in sconfig.batch_steps]
     if stream:
         # encode covers session open + cold restart; "stream" is the cold
@@ -108,13 +116,13 @@ def enumerate_warmup_grid(config, sconfig, stream: Optional[bool] = None,
         # (commit_row always runs at width 1, and under --serve-dp the
         # declared steps are multiples of N, never 1); "szero" builds the
         # pool buffers; "spoison" only exists for chaos drills.
-        grid += [(h, w, 1, kind) for (h, w) in sconfig.buckets
+        grid += [(h, w, 1, kind) for (h, w) in buckets
                  for kind in ("encode", "stream", "szero", "scommit")]
-        grid += [(h, w, b, kind) for (h, w) in sconfig.buckets
+        grid += [(h, w, b, kind) for (h, w) in buckets
                  for b in sconfig.batch_steps
                  for kind in ("sbatch", "scommit")]
         if chaos:
-            grid += [(h, w, 1, "spoison") for (h, w) in sconfig.buckets]
+            grid += [(h, w, 1, "spoison") for (h, w) in buckets]
     keys: List[Key] = []
     seen = set()
     for (h, w, b, kind) in grid:
@@ -380,7 +388,7 @@ def slot_specs(config, pspecs, h: int, w: int, capacity: int):
 
 
 def kind_footprint(config, pspecs, key: Key, capacity: int,
-                   donation: bool = True) -> dict:
+                   donation: bool = True, ragged: bool = False) -> dict:
     """Per-executable device-memory footprint, mirroring the input/output
     signature ``engine._compile`` lowers for this key.
 
@@ -450,6 +458,13 @@ def kind_footprint(config, pspecs, key: Key, capacity: int,
     else:
         raise ValueError(f"unknown executable kind {kind!r}")
 
+    if ragged and kind in ("pair", "stream", "sbatch"):
+        # ragged flow-producing kinds take a per-row [b, 2] int32 live-
+        # size arg; the dense eval_shape above still prices the outputs
+        # correctly (the ragged factories return identical shapes —
+        # sizes only gates which rows carry live data)
+        inputs = tuple(inputs) + (
+            jax.ShapeDtypeStruct((b, 2), jnp.int32),)
     in_b = sum(bytes_of(s) for s in jax.tree.leaves(list(inputs)))
     out_b = tree_bytes(out)
     don_b = tree_bytes(list(donated))
@@ -476,6 +491,7 @@ def config_signature(config, sconfig, stream: bool, chaos: bool) -> dict:
         "stream": stream,
         "chaos": chaos,
         "policy": resolved_policy(config, sconfig),
+        "ragged": bool(getattr(sconfig, "ragged", False)),
     }
 
 
@@ -501,6 +517,7 @@ def analyze(config, sconfig, device_kind: str = "tpu-v4",
     if donation is None:
         donation = device_kind != "cpu"
     rconfig = _resolved_config(config, sconfig)
+    ragged = bool(getattr(sconfig, "ragged", False))
     keys = enumerate_warmup_grid(rconfig, sconfig, stream=stream,
                                  chaos=chaos)
     capacity = max(1, sconfig.max_sessions)
@@ -517,13 +534,18 @@ def analyze(config, sconfig, device_kind: str = "tpu-v4",
     peak_transient = 0
     session_row_b = 0
     violations: List[str] = []
-    for (bh, bw) in sconfig.buckets:
+    # ragged: exactly ONE pool arena (and one executable family) exists,
+    # at the max box — pricing each declared bucket would multiply the
+    # resident pool by a factor that never materializes on the device
+    a_buckets = ([tuple(sconfig.max_box)] if ragged
+                 else [tuple(b) for b in sconfig.buckets])
+    for (bh, bw) in a_buckets:
         pool = slot_specs(rconfig, pspecs, bh, bw, capacity)
         pool_b = tree_bytes(pool)
         row_b = sum(bytes_of(s) // (capacity + 1)
                     for s in jax.tree.leaves(pool))
         kinds = [kind_footprint(rconfig, pspecs, k, capacity,
-                                donation=donation)
+                                donation=donation, ragged=ragged)
                  for k in keys if (k[1], k[2]) == (bh, bw)]
         bucket_peak = max((f["transient_bytes"] for f in kinds), default=0)
         peak_transient = max(peak_transient, bucket_peak)

@@ -31,7 +31,8 @@ from ..lint.contracts import contract
 from ..ops import spmd
 from ..ops.coords import coords_grid, upflow8
 from ..ops.corr import (build_pyramid, fmap2_pyramid, lookup_blockwise_onehot,
-                        lookup_dense, lookup_dense_onehot, lookup_ondemand)
+                        lookup_dense, lookup_dense_onehot, lookup_ondemand,
+                        mask_ragged_rows, ragged_pyramid)
 from ..ops.upsample import convex_upsample_flow
 from ..telemetry.trace import stage
 from ..telemetry.watchdogs import nan_guard
@@ -131,12 +132,22 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
                  flow_init: Optional[jax.Array] = None,
                  all_flows: Optional[bool] = None,
                  rng: Optional[jax.Array] = None,
-                 freeze_bn: bool = False
+                 freeze_bn: bool = False,
+                 sizes: Optional[jax.Array] = None
                  ) -> Tuple[RAFTOutput, Dict[str, dict]]:
     """Run RAFT; returns (output, params-with-updated-BN-stats).
 
     all_flows defaults to ``train`` — training needs every iteration's
     upsampled flow for the sequence loss; inference only the last.
+
+    ``sizes`` ([B, 2] int32, optional) switches on RAGGED mixed-resolution
+    mode: each item is a corner-anchored ``(h_b, w_b)`` crop living in the
+    shared ``[H, W]`` max box, correlation runs the ragged page-scheduled
+    path (one executable for every declared resolution), and the images are
+    re-masked in-graph so dead regions are deterministic zeros whatever the
+    caller embedded.  Output rows are valid inside each item's crop; the
+    caller slices ``flow[b, :h_b, :w_b]``.  None = the dense paths,
+    bit-for-bit unchanged.
 
     ``freeze_bn`` (only meaningful with ``train=True``) runs batch norm in
     eval mode — running statistics used and not updated — while everything
@@ -162,6 +173,13 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
             f"resize the inputs (see data.pipeline.pad_to_multiple).")
     if image2.shape != image1.shape:
         raise ValueError(f"image shapes differ: {image1.shape} vs {image2.shape}")
+    if sizes is not None:
+        # dead regions become exact zeros regardless of what the caller
+        # embedded — every downstream value is then a deterministic function
+        # of (crop pixels, sizes), the batch-independence contract the
+        # ragged serving equality tests rely on
+        image1 = mask_ragged_rows(image1, sizes)
+        image2 = mask_ragged_rows(image2, sizes)
 
     x1 = _preprocess(image1, config)
     x2 = _preprocess(image2, config)
@@ -186,9 +204,11 @@ def raft_forward(params: Dict[str, dict], image1: jax.Array, image2: jax.Array,
     net = jnp.tanh(cnet[..., :config.hidden_dim])
     inp = jax.nn.relu(cnet[..., config.hidden_dim:])
 
+    sizes8 = None if sizes is None else sizes.astype(jnp.int32) // 8
     out = _iterate_flow(params, fmap1, fmap2, net, inp, config,
                         iters=iters, train=train, all_flows=all_flows,
-                        flow_init=flow_init, policy_spec=policy_spec)
+                        flow_init=flow_init, policy_spec=policy_spec,
+                        sizes8=sizes8)
 
     new_params = dict(orig_params)
     if train and not config.small and not freeze_bn:
@@ -208,7 +228,8 @@ def _iterate_flow(params, fmap1: jax.Array, fmap2: jax.Array,
                   iters: int, train: bool, all_flows: bool,
                   flow_init: Optional[jax.Array],
                   policy_spec=None,
-                  active: Optional[jax.Array] = None) -> RAFTOutput:
+                  active: Optional[jax.Array] = None,
+                  sizes8: Optional[jax.Array] = None) -> RAFTOutput:
     """The recurrent core of RAFT, from encoder features to flow.
 
     Shared by :func:`raft_forward` (which computes the features) and
@@ -228,6 +249,12 @@ def _iterate_flow(params, fmap1: jax.Array, fmap2: jax.Array,
     while_loop and report ``iters_used == 0`` — and their outputs are
     discarded by the caller.  None (the default) = all rows real, and
     every existing path is bit-for-bit unchanged.
+
+    ``sizes8`` ([B, 2] int32, optional) selects RAGGED mixed-resolution
+    correlation: per-item live (h, w) extents at the 1/8 query grid, items
+    corner-anchored in the shared max box.  corr_impl='pallas' rides the
+    page-scheduled ragged kernel; 'dense'/'blockwise' ride its exact XLA
+    twin (masked max-box streams through ``lookup_blockwise_onehot``).
     """
     policy, eps, min_iters = (policy_spec if policy_spec is not None
                               else _validate_loop_config(config))
@@ -248,7 +275,37 @@ def _iterate_flow(params, fmap1: jax.Array, fmap2: jax.Array,
     corr_prec = (jax.lax.Precision.HIGHEST if config.corr_precision == "highest"
                  else jax.lax.Precision.DEFAULT)
 
-    if spmd.spatial_axis() is not None:
+    if sizes8 is not None:
+        # ragged mixed-resolution batch: ONE lookup closure serves every
+        # declared crop of the max box (page-scheduled Pallas kernel, or its
+        # exact masked XLA twin off-kernel)
+        if spmd.spatial_axis() is not None:
+            raise NotImplementedError(
+                "ragged mixed-resolution batches under row-sharded (spatial) "
+                "execution are not wired: per-item page schedules would "
+                "straddle shard slabs; use the dense bucket path.")
+        if config.corr_impl == "pallas":
+            try:
+                from ..ops.corr_pallas import make_ragged_fused_lookup
+            except ImportError as e:
+                raise NotImplementedError(
+                    "corr_impl='pallas' requires ops/corr_pallas.py (the "
+                    "fused TPU kernel); use 'dense' or 'blockwise'.") from e
+            lookup = make_ragged_fused_lookup(
+                fmap1c, fmap2c, sizes8, config.corr_levels,
+                config.corr_radius, corr_precision=corr_prec,
+                q_blk=config.pallas_q_blk, p_blk_target=config.pallas_p_blk,
+                lookup_style=config.pallas_lookup_style)
+        else:
+            # 'dense' and 'blockwise' share the masked blockwise twin — the
+            # dense (HW)^2 volume has no ragged form worth building, and the
+            # twin is the kernel's own correctness reference
+            f1m = mask_ragged_rows(fmap1c, sizes8)
+            f2_levels = ragged_pyramid(fmap2c, sizes8, config.corr_levels)
+            lookup = functools.partial(lookup_blockwise_onehot, f1m,
+                                       f2_levels, radius=config.corr_radius,
+                                       precision=corr_prec)
+    elif spmd.spatial_axis() is not None:
         # row-sharded run (make_shard_inference_fn): correlation must see the
         # full fmap2, which lives sharded across devices -> ring pass; with
         # corr_impl='pallas' each slab's partial rides the fused kernel
@@ -545,7 +602,8 @@ def forward_from_features(params: Dict[str, dict], fmap1: jax.Array,
                           fmap2: jax.Array, cnet1: jax.Array,
                           config: RAFTConfig, iters: Optional[int] = None,
                           flow_init: Optional[jax.Array] = None,
-                          active: Optional[jax.Array] = None
+                          active: Optional[jax.Array] = None,
+                          sizes8: Optional[jax.Array] = None
                           ) -> RAFTOutput:
     """Run the recurrent flow core from PRECOMPUTED encoder features.
 
@@ -558,7 +616,9 @@ def forward_from_features(params: Dict[str, dict], fmap1: jax.Array,
     Inference-only: the equivalent of ``raft_forward(train=False,
     all_flows=False)`` on the frames the features came from.  ``active``
     ([B] bool) marks real rows of a slot-padded batch (see
-    :func:`_iterate_flow`); None = all rows real.
+    :func:`_iterate_flow`); None = all rows real.  ``sizes8`` ([B, 2]
+    int32) selects ragged mixed-resolution correlation (per-item live
+    extents at the 1/8 grid; see :func:`_iterate_flow`).
     """
     policy_spec = _validate_loop_config(config)
     params = _cast_params(params, config)
@@ -567,7 +627,8 @@ def forward_from_features(params: Dict[str, dict], fmap1: jax.Array,
     return _iterate_flow(params, fmap1, fmap2, net, inp, config,
                          iters=config.iters if iters is None else iters,
                          train=False, all_flows=False, flow_init=flow_init,
-                         policy_spec=policy_spec, active=active)
+                         policy_spec=policy_spec, active=active,
+                         sizes8=sizes8)
 
 
 def make_encode_fn(config: RAFTConfig):
@@ -674,4 +735,95 @@ def make_counted_inference_fn(config: RAFTConfig,
         out, _ = raft_forward(params, image1, image2, config, iters=iters,
                               train=False, all_flows=False)
         return out.flow, out.iters_used
+    return fn
+
+
+def make_ragged_inference_fn(config: RAFTConfig,
+                             iters: Optional[int] = None):
+    """A jittable ``(params, image1, image2, sizes) -> flow`` function for
+    RAGGED mixed-resolution batches: images are corner-anchored crops
+    zero-embedded in one max box, ``sizes`` [B, 2] int32 the full-res live
+    extents.  One executable serves every declared resolution; row b's flow
+    is valid on ``[:sizes[b,0], :sizes[b,1]]``."""
+    def fn(params, image1, image2, sizes):
+        out, _ = raft_forward(params, image1, image2, config, iters=iters,
+                              train=False, all_flows=False, sizes=sizes)
+        return out.flow
+    return fn
+
+
+def make_ragged_counted_inference_fn(config: RAFTConfig,
+                                     iters: Optional[int] = None):
+    """Ragged twin of :func:`make_counted_inference_fn`:
+    ``(params, image1, image2, sizes) -> (flow, iters_used)``."""
+    def fn(params, image1, image2, sizes):
+        out, _ = raft_forward(params, image1, image2, config, iters=iters,
+                              train=False, all_flows=False, sizes=sizes)
+        return out.flow, out.iters_used
+    return fn
+
+
+def make_ragged_stream_step_fn(config: RAFTConfig,
+                               iters: Optional[int] = None):
+    """Ragged twin of :func:`make_stream_step_fn`: ``(params, image,
+    fmap_prev, cnet_prev, flow_init, sizes) -> (flow, flow_lr, fmap_cur,
+    cnet_cur[, iters_used])`` with every array at the max box and ``sizes``
+    [B, 2] int32 full-res live extents.  The current frame is re-masked
+    in-graph before encoding (deterministic dead regions), and the cached
+    maps handed back are max-box rows a ragged arena stores verbatim."""
+    from ..config import adaptive_iters
+    adaptive = adaptive_iters(config.iters_policy)
+
+    def fn(params, image, fmap_prev, cnet_prev, flow_init, sizes):
+        image = mask_ragged_rows(image, sizes)
+        fmap_cur, cnet_cur = encode_frame(params, image, config)
+        out = forward_from_features(params, fmap_prev, fmap_cur, cnet_prev,
+                                    config, iters=iters, flow_init=flow_init,
+                                    sizes8=sizes.astype(jnp.int32) // 8)
+        if adaptive:
+            return out.flow, out.flow_lr, fmap_cur, cnet_cur, out.iters_used
+        return out.flow, out.flow_lr, fmap_cur, cnet_cur
+    return fn
+
+
+def make_ragged_stream_batch_step_fn(config: RAFTConfig,
+                                     iters: Optional[int] = None):
+    """Ragged twin of :func:`make_stream_batch_step_fn`: ``(params, images,
+    fmap_buf, cnet_buf, flow_buf, slots, active, sizes) -> (flow, flow_lr,
+    fmap_cur, cnet_cur[, iters_used])``.
+
+    ONE device call advances ``b`` sessions of DIFFERENT resolutions by one
+    frame each: buffers are a single max-box arena (every slot row is
+    max-box shaped, each session live only on its corner-anchored crop),
+    ``sizes`` [b, 2] int32 carries per-row full-res extents, and the
+    recurrent core runs the ragged correlation path — so mixed-resolution
+    sessions share one stream batch and one executable per batch step.
+    """
+    from ..config import adaptive_iters
+    adaptive = adaptive_iters(config.iters_policy)
+    quant = config.quant_slots
+
+    def fn(params, images, fmap_buf, cnet_buf, flow_buf, slots, active,
+           sizes):
+        images = mask_ragged_rows(images, sizes)
+        fmap_cur, cnet_cur = encode_frame(params, images, config)
+        if quant:
+            fmap_prev = dequantize_rows(fmap_buf[0][slots],
+                                        fmap_buf[1][slots]
+                                        ).astype(fmap_cur.dtype)
+            cnet_prev = dequantize_rows(cnet_buf[0][slots],
+                                        cnet_buf[1][slots]
+                                        ).astype(cnet_cur.dtype)
+        else:
+            fmap_prev = fmap_buf[slots]
+            cnet_prev = cnet_buf[slots]
+        flow_init = flow_buf[slots]
+        out = forward_from_features(params, fmap_prev, fmap_cur, cnet_prev,
+                                    config, iters=iters,
+                                    flow_init=flow_init, active=active,
+                                    sizes8=sizes.astype(jnp.int32) // 8)
+        if adaptive:
+            return (out.flow, out.flow_lr, fmap_cur, cnet_cur,
+                    out.iters_used)
+        return out.flow, out.flow_lr, fmap_cur, cnet_cur
     return fn

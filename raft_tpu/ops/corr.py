@@ -46,6 +46,49 @@ def fmap2_pyramid(fmap2: jax.Array, num_levels: int = 4) -> List[jax.Array]:
     return levels
 
 
+def mask_ragged_rows(x: jax.Array, sizes: jax.Array) -> jax.Array:
+    """Zero everything outside each item's live crop of a shared max box.
+
+    x: [B, H, W, ...] with every item corner-anchored at (0, 0);
+    sizes: [B, 2] int32 per-item (h, w) live extents.  Dtype-preserving, so
+    it composes with bf16 feature maps and int coordinate planes alike.
+    """
+    B, H, W = x.shape[:3]
+    sizes = sizes.astype(jnp.int32)
+    iy = jax.lax.broadcasted_iota(jnp.int32, (B, H, W), 1)
+    ix = jax.lax.broadcasted_iota(jnp.int32, (B, H, W), 2)
+    live = (iy < sizes[:, 0, None, None]) & (ix < sizes[:, 1, None, None])
+    live = live.reshape(live.shape + (1,) * (x.ndim - 3))
+    return jnp.where(live, x, jnp.zeros((), x.dtype))
+
+
+def ragged_pyramid(fmap2: jax.Array, sizes: jax.Array,
+                   num_levels: int = 4) -> List[jax.Array]:
+    """Ragged twin of :func:`fmap2_pyramid`: every item is a corner-anchored
+    ``sizes[b] = (h_b, w_b)`` crop living in one shared ``[B, Hm, Wm, C]``
+    max box, and each pyramid level re-masks the dead region to zero with the
+    floor-halved extents ``sizes // 2^level``.
+
+    Why the per-level re-mask makes this EXACT (not just approximate) w.r.t.
+    each crop's own pyramid: ``avg_pool2d`` is window-2/stride-2/VALID, so a
+    level-l map keeps rows ``[0, h // 2^l)``.  Every kept window at level
+    l+1 covers rows ``2p, 2p+1 < 2*(h_l // 2)  <= h_l`` — entirely inside the
+    live region — so kept values equal the solo crop's pooled values.  At an
+    ODD live extent the boundary window would mix one live row with one dead
+    (zero) row and emit half the true average, but that window's index is
+    exactly ``h_l // 2``, the first index the next mask kills.  Masking
+    level 0 first, then pool+mask per level, therefore reproduces each
+    crop's standalone pyramid embedded in the max box with zeros outside —
+    the zeros-padding lookup semantics fall out for free.
+    """
+    sizes = sizes.astype(jnp.int32)
+    levels = [mask_ragged_rows(fmap2, sizes)]
+    for _ in range(num_levels - 1):
+        sizes = sizes // 2
+        levels.append(mask_ragged_rows(avg_pool2d(levels[-1], 2, 2), sizes))
+    return levels
+
+
 @contract(fmap1="*[B,H,W,C]", fmap2_l="*[B,H2,W2,C]",
           _returns="f32[B,Q,H2,W2]")
 def dense_corr(fmap1: jax.Array, fmap2_l: jax.Array,

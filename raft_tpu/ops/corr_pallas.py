@@ -39,12 +39,14 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..lint.budget import corr_level_plan
 from ..lint.contracts import contract
-from .corr import fmap2_pyramid, lookup_blockwise_onehot
+from .corr import (fmap2_pyramid, lookup_blockwise_onehot, mask_ragged_rows,
+                   ragged_pyramid)
 
 
 def _use_interpret() -> bool:
@@ -470,5 +472,253 @@ def make_fused_lookup(fmap1: jax.Array, fmap2: jax.Array, num_levels: int,
         return fused_lookup(fmap1, f2_levels, coords, radius, prec,
                             q_blk, p_blk_target, lookup_style, p_select,
                             pack_rows)
+
+    return lookup
+
+
+# ---------------------------------------------------------------------------
+# Ragged fused lookup: one executable for every declared resolution.
+#
+# Mixed-resolution items are corner-anchored crops inside one shared
+# [B, Hm, Wm] max box (sizes[b] = the live (h, w) extents at the query grid).
+# The query/feature streams flatten to [1, B*Qp, C] / [1, B*H2p*W2p, C] and
+# ONE page-scheduled grid walks them: the k-th step of query block j visits
+# the absolute f2 page S[j, k] — item base page + the relative row-block its
+# live bilinear windows overlap — so the kernel never iterates a dense
+# [B, H, W] box and dead tails cost neither DMA nor compute (a repeated
+# schedule entry skips both, exactly like the dense p_select='window' path).
+# Per-level masking (ops.corr.ragged_pyramid) makes every out-of-crop feature
+# row/column zero, so out-of-crop one-hot matches contribute 0 — identical to
+# each crop's own zeros-padding lookup — and the differentiable XLA twin is
+# simply ``lookup_blockwise_onehot`` over the masked max-box streams.
+# ---------------------------------------------------------------------------
+
+
+def _ragged_window_kernel(S_ref, f1_ref, coords_ref, f2_ref, out_ref, *,
+                          body, n_pb):
+    """Page-scheduled program over the flattened query stream: grid
+    ``(B*Qp/T, K)``; step k of query block j visits absolute f2 page
+    ``S[j, k]`` (= item * n_pb + relative row-block).  The body needs the
+    row offset *within the item's plane*, recovered as ``sel % n_pb`` —
+    valid because relative entries never reach ``n_pb``.  A repeated
+    schedule entry skips DMA refetch and compute."""
+    j = pl.program_id(0)
+    k = pl.program_id(1)
+    sel = S_ref[j, k]
+    prev = S_ref[j, jnp.maximum(k - 1, 0)]
+
+    @pl.when((k == 0) | (sel != prev))
+    def _():
+        win = body(sel % n_pb, f1_ref, coords_ref, f2_ref)
+        _accumulate(out_ref, win, k)
+
+
+def _ragged_schedule(coords: jax.Array, live: jax.Array, rows_crop: jax.Array,
+                     level_scale: float, radius: int, T: int, h2_blk: int,
+                     K: int, n_pb: int) -> jax.Array:
+    """[B, Qp, 2] coords + [B, Qp] live mask + [B] per-item live row counts
+    (this level) -> [B*Qp/T, K] absolute page schedule.  Ranges are computed
+    over LIVE queries only and clipped to the item's live rows — dead queries
+    and dead pages contribute exact zeros whichever page is visited, so an
+    all-dead block parks on its item's page 0."""
+    B, Qp, _ = coords.shape
+    n = 2 * radius + 1
+    big = jnp.int32(2 ** 30)
+    cy = coords[..., 1] * level_scale                      # [B, Qp]
+    iy0 = jnp.floor(cy).astype(jnp.int32) - radius
+    iyb = iy0.reshape(B, Qp // T, T)
+    lvb = live.reshape(B, Qp // T, T)
+    lo = jnp.where(lvb, iyb, big).min(axis=2)              # [B, Jb]
+    hi = jnp.where(lvb, iyb, -big).max(axis=2) + n         # inclusive last row
+    rc = rows_crop.astype(jnp.int32)[:, None]              # [B, 1]
+    any_rows = lvb.any(axis=2) & (hi >= 0) & (lo < rc) & (rc > 0)
+    b_lo = jnp.where(any_rows, jnp.clip(lo, 0, rc - 1) // h2_blk, 0)
+    b_hi = jnp.where(any_rows, jnp.clip(hi, 0, rc - 1) // h2_blk, 0)
+    ks = jnp.arange(K, dtype=jnp.int32)[None, None, :]
+    rel = b_lo[..., None] + jnp.minimum(ks, (b_hi - b_lo)[..., None])
+    item = jnp.arange(B, dtype=jnp.int32)[:, None, None] * n_pb
+    return (item + rel).reshape(B * (Qp // T), K).astype(jnp.int32)
+
+
+def _ragged_lookup_level(f1: jax.Array, f2_level: jax.Array,
+                         coords: jax.Array, live: jax.Array,
+                         rows_crop: jax.Array, radius: int, level: int, *,
+                         q_blk: int, p_blk_target: int, interpret: bool,
+                         corr_precision=jax.lax.Precision.HIGHEST,
+                         lookup_style: str = "matmul") -> jax.Array:
+    """f1 [B,Q,C] (dead rows zero), f2_level [B,H2,W2,C] (pre-masked),
+    coords [B,Q,2], live [B,Q] bool, rows_crop [B] int32 live rows at this
+    level -> [B,Q,(2r+1)^2]."""
+    B, Q, C = f1.shape
+    _, H2, W2, _ = f2_level.shape
+    n = 2 * radius + 1
+    if H2 == 0 or W2 == 0:
+        return jnp.zeros((B, Q, n * n), jnp.float32)
+
+    # identical padding/blocking plan to the dense path (lint/budget.py
+    # prices exactly this); row packing does not compose with per-item page
+    # addressing, so ragged levels always run unpacked.
+    plan = corr_level_plan(Q, H2, W2, q_blk=q_blk,
+                           p_blk_target=p_blk_target, pack_rows=False)
+    T, Qp = plan.t, plan.qp
+    if Qp != Q:
+        f1 = jnp.pad(f1, ((0, 0), (0, Qp - Q), (0, 0)))
+        # edge-pad coords (window schedule of the tail block stays put);
+        # padded queries are DEAD, so their output is exact zero regardless
+        coords = jnp.pad(coords, ((0, 0), (0, Qp - Q), (0, 0)), mode="edge")
+        live = jnp.pad(live, ((0, 0), (0, Qp - Q)))
+    W2p, h2_blk = plan.w2p, plan.h2_blk
+    n_pb = plan.n_pblocks
+    H2p = plan.rows_padded
+    f2 = f2_level
+    if H2p != H2 or W2p != W2:
+        f2 = jnp.pad(f2, ((0, 0), (0, H2p - H2), (0, W2p - W2), (0, 0)))
+
+    body = functools.partial(
+        _window_body, level_scale=1.0 / (2.0 ** level),
+        corr_scale=1.0 / (C ** 0.5), radius=radius, h2_blk=h2_blk,
+        w2=W2p, corr_precision=corr_precision, lookup_style=lookup_style)
+
+    # flatten to per-item-page streams: query block j serves item j // (Qp/T)
+    # (Qp is uniform across items, so blocks never straddle an item), and
+    # item b's plane occupies absolute pages [b*n_pb, (b+1)*n_pb).
+    f1s = f1.astype(jnp.float32).reshape(1, B * Qp, C)
+    cs = coords.astype(jnp.float32).reshape(1, B * Qp, 2)
+    f2s = f2.astype(jnp.float32).reshape(1, B * H2p * W2p, C)
+    grid = (B * Qp // T, n_pb)
+    S = _ragged_schedule(coords.astype(jnp.float32), live, rows_crop,
+                         1.0 / (2.0 ** level), radius, T, h2_blk,
+                         grid[1], n_pb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, T, C), lambda j, k, S: (0, j, 0)),
+            pl.BlockSpec((1, T, 2), lambda j, k, S: (0, j, 0)),
+            pl.BlockSpec((1, h2_blk * W2p, C),
+                         lambda j, k, S: (0, S[j, k], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, T, n, n),
+                               lambda j, k, S: (0, j, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_ragged_window_kernel, body=body, n_pb=n_pb),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, B * Qp, n, n), jnp.float32),
+        interpret=interpret,
+    )(S, f1s, cs, f2s)
+    out = out.reshape(B, Qp, n * n)
+    return out[:, :Q] if Qp != Q else out
+
+
+@contract(fmap1="f32[B,H,W,C]", coords="f32[B,H,W,2]", sizes8="i32[B,2]",
+          _returns="f32[B,H,W,N]")
+def _ragged_fused_lookup_impl(fmap1: jax.Array, f2_levels: Sequence[jax.Array],
+                              coords: jax.Array, sizes8: jax.Array,
+                              radius: int, q_blk: int = 128,
+                              p_blk_target: int = 4096,
+                              interpret: Optional[bool] = None,
+                              corr_precision=jax.lax.Precision.HIGHEST,
+                              lookup_style: str = "matmul") -> jax.Array:
+    B, H, W, C = fmap1.shape
+    Q = H * W
+    if lookup_style not in ("matmul", "vpu"):
+        raise ValueError(f"lookup_style must be 'matmul' or 'vpu', "
+                         f"got {lookup_style!r}")
+    interp = _use_interpret() if interpret is None else interpret
+    f1 = fmap1.reshape(B, Q, C)
+    cf = coords.reshape(B, Q, 2)
+    sizes8 = sizes8.astype(jnp.int32)
+    iy = jax.lax.broadcasted_iota(jnp.int32, (B, H, W), 1)
+    ix = jax.lax.broadcasted_iota(jnp.int32, (B, H, W), 2)
+    live = ((iy < sizes8[:, 0, None, None])
+            & (ix < sizes8[:, 1, None, None])).reshape(B, Q)
+    rows = sizes8[:, 0]
+    outs = [
+        _ragged_lookup_level(f1, f2l, cf, live, rows // (2 ** i), radius, i,
+                             q_blk=q_blk, p_blk_target=p_blk_target,
+                             interpret=interp, corr_precision=corr_precision,
+                             lookup_style=lookup_style)
+        for i, f2l in enumerate(f2_levels)
+    ]
+    return jnp.concatenate(outs, axis=-1).reshape(B, H, W, -1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def ragged_fused_lookup(fmap1: jax.Array, f2_levels: Tuple[jax.Array, ...],
+                        coords: jax.Array, sizes8: jax.Array, radius: int,
+                        corr_precision=jax.lax.Precision.HIGHEST,
+                        q_blk: int = 128, p_blk_target: int = 4096,
+                        lookup_style: str = "matmul") -> jax.Array:
+    """Ragged Pallas-fused correlation lookup.
+
+    fmap1 [B,Hm,Wm,C] with dead regions zeroed (:func:`mask_ragged_rows`),
+    f2_levels the masked :func:`ragged_pyramid` of the max box, coords
+    [B,Hm,Wm,2], sizes8 [B,2] int32 live (h, w) per item at the query grid
+    -> [B, Hm, Wm, L*(2r+1)^2].  Restricted to item b's live crop the output
+    equals ``fused_lookup`` run standalone on that crop; dead queries are
+    exact zeros.  ``sizes8`` is a regular (traced) argument so ONE
+    executable serves every declared resolution — it carries a float0
+    cotangent (integer metadata has no gradient)."""
+    return _ragged_fused_lookup_impl(fmap1, f2_levels, coords, sizes8,
+                                     radius, q_blk=q_blk,
+                                     p_blk_target=p_blk_target,
+                                     corr_precision=corr_precision,
+                                     lookup_style=lookup_style)
+
+
+def _ragged_fused_lookup_fwd(fmap1, f2_levels, coords, sizes8, radius,
+                             corr_precision, q_blk, p_blk_target,
+                             lookup_style):
+    return _ragged_fused_lookup_impl(fmap1, f2_levels, coords, sizes8,
+                                     radius, q_blk=q_blk,
+                                     p_blk_target=p_blk_target,
+                                     corr_precision=corr_precision,
+                                     lookup_style=lookup_style), (
+        fmap1, f2_levels, coords, sizes8)
+
+
+def _ragged_fused_lookup_bwd(radius, corr_precision, q_blk, p_blk_target,
+                             lookup_style, residuals, g):
+    # gradients via the same matmul-only XLA twin as the dense kernel: the
+    # masked max-box streams make lookup_blockwise_onehot the exact ragged
+    # reference, so its vjp is the exact ragged backward (dead-region
+    # gradients die at the upstream mask).
+    fmap1, f2_levels, coords, sizes8 = residuals
+    _, vjp = jax.vjp(
+        lambda a, b, c: lookup_blockwise_onehot(a, tuple(b), c, radius,
+                                                precision=corr_precision),
+        fmap1, tuple(f2_levels), coords)
+    da, db, dc = vjp(g)
+    return da, db, dc, np.zeros(sizes8.shape, jax.dtypes.float0)
+
+
+ragged_fused_lookup.defvjp(_ragged_fused_lookup_fwd, _ragged_fused_lookup_bwd)
+
+
+@contract(fmap1="*[B,H,W,C]", fmap2="*[B,H,W,C]", sizes8="i32[B,2]")
+def make_ragged_fused_lookup(fmap1: jax.Array, fmap2: jax.Array,
+                             sizes8: jax.Array, num_levels: int, radius: int,
+                             corr_precision="highest", q_blk: int = 128,
+                             p_blk_target: int = 4096,
+                             lookup_style: str = "matmul"):
+    """Ragged twin of :func:`make_fused_lookup` for mixed-resolution batches
+    sharing one max box: masks frame-1 features and builds the re-masked
+    pyramid once, then every GRU iteration runs the page-scheduled ragged
+    kernel.  ``p_select``/``pack_rows`` do not apply — page scheduling IS the
+    window selection, and row packing does not compose with per-item pages.
+    """
+    f2_levels = tuple(ragged_pyramid(fmap2.astype(jnp.float32), sizes8,
+                                     num_levels))
+    fmap1 = mask_ragged_rows(fmap1.astype(jnp.float32), sizes8)
+    if isinstance(corr_precision, jax.lax.Precision):
+        prec = corr_precision
+    else:
+        prec = (jax.lax.Precision.HIGHEST if corr_precision == "highest"
+                else jax.lax.Precision.DEFAULT)
+
+    def lookup(coords: jax.Array) -> jax.Array:
+        return ragged_fused_lookup(fmap1, f2_levels, coords, sizes8, radius,
+                                   prec, q_blk, p_blk_target, lookup_style)
 
     return lookup

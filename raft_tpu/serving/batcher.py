@@ -107,9 +107,21 @@ class MicroBatcher:
                  stream_fn: Optional[Callable] = None,
                  stream_group_fn: Optional[Callable] = None,
                  breaker=None, faults=None, retries: int = 1,
-                 retry_backoff_s: float = 0.02, on_crash=None):
+                 retry_backoff_s: float = 0.02, on_crash=None,
+                 ragged: bool = False, ragged_batch_pixels: int = 0):
         self.queue = queue
         self.run_fn = run_fn
+        # ragged mixed-resolution mode (SERVING.md "Ragged serving"):
+        # every pairwise request is queued under the shared max-box
+        # bucket (so the FIFO coalesces across resolutions for free) and
+        # run_fn is called with a 4th arg — per-row [b, 2] int32 live
+        # sizes built from each request's routed bucket (Request.rbucket).
+        # ragged_batch_pixels > 0 bounds one device batch's LIVE-pixel
+        # footprint: a popped run is greedily chunked so co-batched live
+        # pixels never exceed the budget (a 1080p row can't starve a
+        # group of thumbnails); 0 = unbounded.
+        self.ragged = ragged
+        self.ragged_batch_pixels = ragged_batch_pixels
         # streaming steps (serving/stream.py) ride the same queue and the
         # same device-owning thread: stream_fn takes ONE StreamRequest
         # (session open / solo fallback) and returns (padded flow or
@@ -162,6 +174,40 @@ class MicroBatcher:
             m.labels(args[0]).inc(args[1])
         elif hasattr(m, "inc"):
             m.inc(*args)
+
+    def _observe_waste(self, group, padded: int) -> None:
+        """raft_batch_padding_waste_ratio: the fraction of one device
+        batch's pixels that is padding — batch-fill rows plus, under
+        --ragged, each row's dead embedding beyond its routed resolution
+        (``pads`` are relative to the device box in both modes, so live
+        pixels fall straight out of them).  Dense same-bucket batches
+        report only the batch-fill share; the ragged sweep compares the
+        two."""
+        bh, bw = group[0].bucket[-2:]
+        box = float(bh * bw)
+        live = sum((bh - p[0] - p[1]) * (bw - p[2] - p[3])
+                   for p in (r.pads for r in group))
+        self._observe("padding_waste", (padded * box - live) / (padded * box))
+
+    def _chunks(self, batch):
+        """Split a popped run so one chunk's live-pixel footprint stays
+        under ``ragged_batch_pixels`` (never splitting below one row);
+        identity in dense mode or with the budget unset."""
+        if not self.ragged or self.ragged_batch_pixels <= 0 \
+                or len(batch) < 2:
+            return [batch]
+        out, cur, acc = [], [], 0
+        for r in batch:
+            h, w = r.rbucket
+            px = h * w
+            if cur and acc + px > self.ragged_batch_pixels:
+                out.append(cur)
+                cur, acc = [], 0
+            cur.append(r)
+            acc += px
+        if cur:
+            out.append(cur)
+        return out
 
     def _fail_expired(self, expired) -> None:
         now = time.monotonic()
@@ -282,6 +328,7 @@ class MicroBatcher:
         for r in traced:
             r.trace.span("queue_wait", r.enqueued_at, r.dequeued_at)
             r.trace.span("batch_form", r.dequeued_at, t_form1, group=n)
+        self._observe_waste(group, padded)
         self._observe("inflight", 1)
         if traced:
             tlm_spans.set_device_slot([])
@@ -387,21 +434,23 @@ class MicroBatcher:
                 for r in batch:
                     self._execute_stream(r)
             return
-        n = len(batch)
-        padded = self.pad_batch_to(min(n, self.max_batch))
         for r in batch:
             if r.trace is not None:
                 r.trace.span("queue_wait", r.enqueued_at, r.dequeued_at)
-        self._observe("batch_size", float(n))
-        self._observe("batch_occupancy", n / padded)
-        self._observe("inflight", 1)
-        t0 = time.monotonic()
-        try:
-            budget = [self._bisect_budget(n)]
-            self._run_group(batch, budget)
-        finally:
-            self._observe("inflight", -1)
-            self._observe("batch_latency", time.monotonic() - t0)
+        for group in self._chunks(batch):
+            n = len(group)
+            padded = self.pad_batch_to(min(n, self.max_batch))
+            self._observe("batch_size", float(n))
+            self._observe("batch_occupancy", n / padded)
+            self._observe_waste(group, padded)
+            self._observe("inflight", 1)
+            t0 = time.monotonic()
+            try:
+                budget = [self._bisect_budget(n)]
+                self._run_group(group, budget)
+            finally:
+                self._observe("inflight", -1)
+                self._observe("batch_latency", time.monotonic() - t0)
 
     def _run_group(self, group, budget, formed: bool = False) -> None:
         """Run one same-bucket group; on persistent engine failure, split
@@ -431,7 +480,16 @@ class MicroBatcher:
             attempts += 1
             budget[0] -= 1
             try:
-                out = self.run_fn(group[0].bucket, im1, im2)
+                if self.ragged:
+                    # per-row live sizes from each request's routed
+                    # bucket; filler rows repeat the last request's, to
+                    # match its repeated pixels
+                    rb = ([r.rbucket for r in group]
+                          + [group[-1].rbucket] * (padded - n))
+                    out = self.run_fn(group[0].bucket, im1, im2,
+                                      np.asarray(rb, np.int32))
+                else:
+                    out = self.run_fn(group[0].bucket, im1, im2)
             except Exception as e:
                 # transient device errors heal under a short backoff;
                 # persistent ones fall through to bisection below

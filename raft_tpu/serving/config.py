@@ -170,6 +170,20 @@ class ServeConfig:
     anomaly: bool = True
     anomaly_window_s: float = 15.0
     anomaly_baseline_s: float = 60.0
+    # Ragged mixed-resolution serving (SERVING.md "Ragged serving"): ONE
+    # executable per (kind, batch-step, policy) serves EVERY declared bucket
+    # — requests stay routed to their minimal bucket for padding accounting,
+    # then ride the shared max-box executable with per-row (h, w) size
+    # metadata; the batcher coalesces ACROSS buckets and mixed-resolution
+    # stream sessions share one slot arena and one sbatch step.  The warmup
+    # grid (and the AOT cache) shrinks from O(buckets x batch-steps) to
+    # O(batch-steps).
+    ragged: bool = False
+    # Optional footprint budget for ragged coalescing: max live (un-padded)
+    # pixels per request group, summed over the routed buckets of its
+    # members.  A group exceeding it is split greedily in arrival order.
+    # 0 = no cap (max_batch alone bounds the group).
+    ragged_batch_pixels: int = 0
 
     def __post_init__(self):
         if self.batch_steps is None:
@@ -251,6 +265,21 @@ class ServeConfig:
                              f"{self.max_batch}: full batches could never run")
         object.__setattr__(self, "batch_steps", steps)
         object.__setattr__(self, "buckets", tuple(self.buckets))
+        if self.ragged_batch_pixels < 0:
+            raise ValueError(f"ragged_batch_pixels must be >= 0 (0 = no "
+                             f"cap), got {self.ragged_batch_pixels}")
+        if self.ragged and self.dp_devices > 1:
+            raise NotImplementedError(
+                "ragged serving under dp_devices > 1 is not wired: the "
+                "ragged model entry points are single-mesh; use dense "
+                "buckets or dp_devices=1")
+
+    @property
+    def max_box(self) -> Tuple[int, int]:
+        """The shared ragged max box: componentwise max over the declared
+        buckets (every bucket embeds corner-anchored inside it)."""
+        return (max(h for h, _ in self.buckets),
+                max(w for _, w in self.buckets))
 
     def route(self, h: int, w: int):
         """Smallest declared bucket containing (h, w), or None — minimal

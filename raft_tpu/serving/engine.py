@@ -102,6 +102,14 @@ class InferenceEngine:
         self.iters = iters
         self.iters_policy = config.iters_policy
         self.adaptive = adaptive_iters(config.iters_policy)
+        # ragged mixed-resolution serving: every flow-producing executable
+        # takes a per-row [b, 2] int32 sizes argument and runs at the max
+        # box, so ONE (kind, b, policy) executable serves every declared
+        # bucket — the cache key keeps its 5-tuple schema, but only max-box
+        # (h, w) values ever appear in it (the warmup grid collapses to
+        # O(batch-steps), lint/budget.enumerate_warmup_grid)
+        self.ragged = bool(sconfig.ragged)
+        self.max_box = sconfig.max_box
         # aot_cache.EngineCache or None: warmup load-or-compiles through
         # it, export_cache() populates it for the fleet's shared dir
         self.cache = cache
@@ -123,6 +131,12 @@ class InferenceEngine:
             self._mesh = make_mesh(sconfig.dp_devices)
             self._fn = make_dp_eval_fn(config, self._mesh, iters=iters,
                                        with_iters=self.adaptive)
+        elif self.ragged:
+            from ..models.raft import (make_ragged_counted_inference_fn,
+                                       make_ragged_inference_fn)
+            make = (make_ragged_counted_inference_fn if self.adaptive
+                    else make_ragged_inference_fn)
+            self._fn = jax.jit(make(config, iters=iters))
         else:
             from ..models.raft import (make_counted_inference_fn,
                                        make_inference_fn)
@@ -137,17 +151,23 @@ class InferenceEngine:
             # cannot shard over the data axis); they live in the same
             # cache and warm grid
             from ..models.raft import (make_encode_fn,
+                                       make_ragged_stream_batch_step_fn,
+                                       make_ragged_stream_step_fn,
                                        make_stream_batch_step_fn,
                                        make_stream_step_fn)
             from .session import (SlotPool, make_slot_commit_fn,
                                   make_slot_poison_fn)
             if self.pool is None:
-                self.pool = SlotPool(max(1, sconfig.max_sessions))
+                self.pool = SlotPool(max(1, sconfig.max_sessions),
+                                     arena=(self.max_box if self.ragged
+                                            else None))
             self._encode_fn = jax.jit(make_encode_fn(config))
-            self._stream_fn = jax.jit(make_stream_step_fn(config,
-                                                          iters=iters))
-            self._sbatch_fn = jax.jit(make_stream_batch_step_fn(
-                config, iters=iters))
+            mk_stream = (make_ragged_stream_step_fn if self.ragged
+                         else make_stream_step_fn)
+            mk_sbatch = (make_ragged_stream_batch_step_fn if self.ragged
+                         else make_stream_batch_step_fn)
+            self._stream_fn = jax.jit(mk_stream(config, iters=iters))
+            self._sbatch_fn = jax.jit(mk_sbatch(config, iters=iters))
             # the pool buffers are DONATED into the scatter executables so
             # a commit updates rows in place (off-CPU; the CPU backend has
             # no donation, so skip it there and keep warmup logs quiet)
@@ -252,7 +272,13 @@ class InferenceEngine:
 
         kind, h, w, b = key[:4]
         img = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
+        # ragged: flow-producing kinds take per-row [b, 2] int32 live sizes
+        # (the only shape-bearing metadata — it is a runtime argument, so
+        # one executable serves every declared resolution)
+        sz = jax.ShapeDtypeStruct((b, 2), jnp.int32)
         if kind == "pair":
+            if self.ragged:
+                return self._fn.lower(self.params, img, img, sz).compile()
             return self._fn.lower(self.params, img, img).compile()
         if kind == "encode":
             return self._encode_fn.lower(self.params, img).compile()
@@ -260,12 +286,18 @@ class InferenceEngine:
             fmap_s, cnet_s = self._feature_shapes(h, w, b)
             flow_s = jax.ShapeDtypeStruct((b, h // 8, w // 8, 2),
                                           jnp.float32)
+            if self.ragged:
+                return self._stream_fn.lower(self.params, img, fmap_s,
+                                             cnet_s, flow_s, sz).compile()
             return self._stream_fn.lower(self.params, img, fmap_s, cnet_s,
                                          flow_s).compile()
         fbuf, cbuf, flbuf = self._slot_specs(h, w)
         idx = jax.ShapeDtypeStruct((b,), jnp.int32)
         mask = jax.ShapeDtypeStruct((b,), jnp.bool_)
         if kind == "sbatch":
+            if self.ragged:
+                return self._sbatch_fn.lower(self.params, img, fbuf, cbuf,
+                                             flbuf, idx, mask, sz).compile()
             return self._sbatch_fn.lower(self.params, img, fbuf, cbuf,
                                          flbuf, idx, mask).compile()
         if kind == "scommit":
@@ -456,7 +488,10 @@ class InferenceEngine:
                     pair_keys, key=lambda k: k[1] * k[2] * k[3])
                 ex = self._get_executable(self._key(h, w, b, kind))
                 img = np.zeros((b, h, w, 3), np.float32)
-                out = ex(staged, img, img)
+                if self.ragged:
+                    out = ex(staged, img, img, self._sizes_arg(b, None))
+                else:
+                    out = ex(staged, img, img)
                 flow = np.asarray(out[0] if self.adaptive else out)
                 if not np.all(np.isfinite(flow)):
                     raise ReloadMismatch(
@@ -474,12 +509,24 @@ class InferenceEngine:
 
     # -- the device call --------------------------------------------------
 
+    def _sizes_arg(self, n: int, sizes) -> np.ndarray:
+        """Per-row [n, 2] int32 live-size metadata for a ragged device
+        call.  None = every row live on the full max box (direct engine
+        callers and padding rows)."""
+        if sizes is None:
+            h, w = self.max_box
+            return np.tile(np.asarray([[h, w]], np.int32), (n, 1))
+        return np.asarray(sizes, np.int32)
+
     def run(self, bucket: Tuple[int, int], im1: np.ndarray,
-            im2: np.ndarray):
+            im2: np.ndarray, sizes=None):
         """[n, BH, BW, 3] float32 pair -> [n, BH, BW, 2] float32 flow.
         ``n`` must be a declared batch step (the batcher pads to one).
         Under a converge policy returns (flow, iters_used [n] int32) —
-        the batcher passes per-row counts through to each request."""
+        the batcher passes per-row counts through to each request.
+        ``sizes`` ([n, 2] int32) is required-by-convention in ragged mode:
+        per-row live extents inside the max-box ``bucket`` (None = all rows
+        full box); ignored in dense mode."""
         h, w = bucket
         n = im1.shape[0]
         ex = self._get_executable(self._key(h, w, n))
@@ -492,7 +539,10 @@ class InferenceEngine:
         # is enqueued (async dispatch — wall clock at the call site lies),
         # np.asarray is what actually waits for the device
         t0 = time.monotonic()
-        out = ex(self.params, im1, im2)
+        if self.ragged:
+            out = ex(self.params, im1, im2, self._sizes_arg(n, sizes))
+        else:
+            out = ex(self.params, im1, im2)
         t1 = time.monotonic()
         if self.adaptive:
             flow, iters_used = out
@@ -528,20 +578,26 @@ class InferenceEngine:
         return out
 
     def run_stream(self, bucket: Tuple[int, int], image: np.ndarray,
-                   fmap_prev, cnet_prev, flow_init: np.ndarray):
+                   fmap_prev, cnet_prev, flow_init: np.ndarray,
+                   sizes=None):
         """One sessionful step: current frame + cached previous maps +
         warm-start seed -> (flow [1,BH,BW,2] np, flow_lr [1,bh,bw,2] np,
         fmap_cur dev, cnet_cur dev, iters_used np or None).  Exactly one
         fnet pass per call — the streaming saving the tests assert via
-        ``encode_calls``/``stream_calls``."""
+        ``encode_calls``/``stream_calls``.  ``sizes`` as in :meth:`run`."""
         h, w = bucket
-        ex = self._get_executable(self._key(h, w, image.shape[0], "stream"))
+        n = image.shape[0]
+        ex = self._get_executable(self._key(h, w, n, "stream"))
         with self._lock:
             self.stream_calls += 1
         if self.faults is not None:
             self.faults.pre_engine_call()
         t0 = time.monotonic()
-        out = ex(self.params, image, fmap_prev, cnet_prev, flow_init)
+        if self.ragged:
+            out = ex(self.params, image, fmap_prev, cnet_prev, flow_init,
+                     self._sizes_arg(n, sizes))
+        else:
+            out = ex(self.params, image, fmap_prev, cnet_prev, flow_init)
         t1 = time.monotonic()
         if self.adaptive:
             flow, flow_lr, fmap, cnet, iters_used = out
@@ -559,7 +615,8 @@ class InferenceEngine:
     # -- the continuous-batched stream path (slot pool) --------------------
 
     def run_stream_batch(self, bucket: Tuple[int, int], images: np.ndarray,
-                         slots: np.ndarray, active: np.ndarray):
+                         slots: np.ndarray, active: np.ndarray,
+                         sizes=None):
         """ONE device call advancing ``active.sum()`` different sessions:
         ``images`` [b, BH, BW, 3] (padded to a declared batch step),
         ``slots`` [b] int32 pool rows (padding rows aim at the scratch
@@ -579,8 +636,13 @@ class InferenceEngine:
             self.faults.pre_engine_call()
         fbuf, cbuf, flbuf = self.pool.buffers(bucket)
         t0 = time.monotonic()
-        out = ex(self.params, images, fbuf, cbuf, flbuf,
-                 np.asarray(slots, np.int32), np.asarray(active, bool))
+        if self.ragged:
+            out = ex(self.params, images, fbuf, cbuf, flbuf,
+                     np.asarray(slots, np.int32), np.asarray(active, bool),
+                     self._sizes_arg(b, sizes))
+        else:
+            out = ex(self.params, images, fbuf, cbuf, flbuf,
+                     np.asarray(slots, np.int32), np.asarray(active, bool))
         t1 = time.monotonic()
         if self.adaptive:
             flow, flow_lr, fmap_rows, cnet_rows, iters_used = out

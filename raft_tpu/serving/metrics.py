@@ -55,6 +55,13 @@ def make_serving_metrics(registry: Registry, config,
             "raft_serving_batch_occupancy",
             "Real requests / padded batch size per device call",
             buckets=occ),
+        "padding_waste": registry.histogram(
+            "raft_batch_padding_waste_ratio",
+            "Padding pixels / total pixels per device batch: batch-fill "
+            "rows plus, under --ragged, each row's dead embedding beyond "
+            "its routed resolution (observed on pairwise and coalesced "
+            "stream batches alike)",
+            buckets=occ),
         "request_latency": registry.histogram(
             "raft_serving_request_latency_seconds",
             "End-to-end request latency (enqueue to result)"),
@@ -172,6 +179,17 @@ def make_stream_metrics(registry: Registry, store,
                 functools.partial(pool.in_use, (h, w)))
             cap.labels(f"{h}x{w}").set(pool.capacity)
         m["slots_in_use"], m["slot_capacity"] = in_use, cap
+        if getattr(pool, "arena", None) is not None:
+            # ragged arena (SERVING.md "Ragged serving"): the buckets all
+            # map onto one max-box arena, so per-bucket in_use gauges
+            # report the shared count; this gauge prices how much of the
+            # allocated arena rows is LIVE page pixels (vs dead embedding)
+            m["arena_live_pixels"] = registry.gauge(
+                "raft_stream_arena_live_pixels",
+                "Live page pixels resident in the shared ragged slot "
+                "arena (sum of slot extents; the box-pixel denominator "
+                "is slots_in_use x arena h x w)",
+                fn=functools.partial(pool.used_pixels, pool.arena))
     return m
 
 

@@ -63,18 +63,25 @@ class Request:
     blocks on ``wait()``; the batcher thread delivers via ``resolve``/
     ``fail``."""
 
-    __slots__ = ("id", "image1", "image2", "bucket", "pads", "deadline",
-                 "enqueued_at", "dequeued_at", "finished_at", "_done",
-                 "result", "error", "batch_real", "batch_padded",
+    __slots__ = ("id", "image1", "image2", "bucket", "rbucket", "pads",
+                 "deadline", "enqueued_at", "dequeued_at", "finished_at",
+                 "_done", "result", "error", "batch_real", "batch_padded",
                  "iters_used", "trace")
 
     def __init__(self, image1: np.ndarray, image2: np.ndarray,
                  bucket: Tuple[int, int], pads: Tuple[int, int, int, int],
-                 deadline: float):
+                 deadline: float,
+                 rbucket: Optional[Tuple[int, int]] = None):
         self.id = next(_ids)
         self.image1 = image1          # padded [1, BH, BW, 3] float32
         self.image2 = image2
         self.bucket = bucket
+        # routed bucket: the resolution this request was routed to before
+        # any ragged max-box embedding.  == bucket in dense mode; under
+        # --ragged, bucket is the shared max box (so the FIFO coalesces
+        # across resolutions) and rbucket is the live extent the batcher
+        # passes as the row's sizes.
+        self.rbucket = bucket if rbucket is None else rbucket
         self.pads = pads
         self.deadline = deadline      # monotonic seconds
         self.enqueued_at = time.monotonic()

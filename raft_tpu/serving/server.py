@@ -25,7 +25,7 @@ from typing import Optional
 import numpy as np
 
 from ..config import RAFTConfig
-from ..data.pipeline import pad_to_shape
+from ..data.pipeline import embed_to_shape, pad_to_shape
 from ..lint.concurrency import SERVING_LOCK_HIERARCHY
 from ..telemetry import events as tlm_events
 from ..telemetry import spans as tlm_spans
@@ -212,7 +212,17 @@ class FlowServer:
         # server keeps its exact warmup grid and /metrics exposition
         self.streams = None
         if sconfig.max_sessions > 0:
-            store = SessionStore(sconfig.max_sessions, sconfig.session_ttl_s)
+            # under --ragged the store's slot pool must be the ARENA pool:
+            # every routed bucket shares one max-box free-list, so two
+            # sessions of different resolutions can never be handed the
+            # same buffer row (the engine reuses this pool; its own
+            # arena-aware construction only applies when none is injected)
+            from .session import SlotPool
+            store = SessionStore(
+                sconfig.max_sessions, sconfig.session_ttl_s,
+                pool=SlotPool(sconfig.max_sessions,
+                              arena=(sconfig.max_box if sconfig.ragged
+                                     else None)))
             stream_metrics = make_stream_metrics(self.registry, store,
                                                  buckets=sconfig.buckets)
             self.streams = StreamCoordinator(
@@ -257,7 +267,9 @@ class FlowServer:
             breaker=self.breaker, faults=self.faults,
             retries=sconfig.engine_retries,
             retry_backoff_s=sconfig.retry_backoff_ms / 1000.0,
-            on_crash=self._batcher_crashed)
+            on_crash=self._batcher_crashed,
+            ragged=sconfig.ragged,
+            ragged_batch_pixels=sconfig.ragged_batch_pixels)
         self.supervisor = BatcherSupervisor(
             self, counter=self._robustness["batcher_restarts"],
             degraded_window_s=sconfig.degraded_window_s)
@@ -270,12 +282,17 @@ class FlowServer:
     # -- engine bridge (compile-cache accounting lives server-side so a
     #    stub engine still produces hit/miss metrics when it exposes them) -
 
-    def _run_engine(self, bucket, im1, im2):
+    def _run_engine(self, bucket, im1, im2, sizes=None):
         self._trace_window.on_step(self._device_batches)
         self._device_batches += 1
         before = getattr(self.engine, "compile_misses", None)
         with stage("serve/batch"):
-            out = self.engine.run(bucket, im1, im2)
+            # sizes (ragged per-row extents) only flows when the batcher
+            # passes it, so dense-mode stub engines keep their 3-arg run()
+            if sizes is not None:
+                out = self.engine.run(bucket, im1, im2, sizes)
+            else:
+                out = self.engine.run(bucket, im1, im2)
         if before is not None:
             after = self.engine.compile_misses
             if after > before:
@@ -568,8 +585,25 @@ class FlowServer:
                 raise BadRequest(f"deadline_ms must be positive, got {dl}")
             im1p, pads = pad_to_shape(im1[None].astype(np.float32), bucket)
             im2p, _ = pad_to_shape(im2[None].astype(np.float32), bucket)
+            rbucket = None
+            if self.sconfig.ragged:
+                # ragged: zero-embed the routed-bucket pair corner-
+                # anchored into the shared max box and queue it UNDER the
+                # max box, so requests of every resolution share one FIFO
+                # (cross-resolution coalescing) and one executable.  The
+                # embedding folds into pads so unpad() recovers (h, w)
+                # straight from the max-box flow; the routed bucket rides
+                # in rbucket — the batcher turns it into the row's sizes.
+                rbucket = bucket
+                (bh, bw), (mh, mw) = bucket, self.sconfig.max_box
+                im1p = embed_to_shape(im1p, self.sconfig.max_box)
+                im2p = embed_to_shape(im2p, self.sconfig.max_box)
+                t, b_, l_, r_ = pads
+                pads = (t, b_ + mh - bh, l_, r_ + mw - bw)
+                bucket = self.sconfig.max_box
             req = Request(im1p, im2p, bucket, pads,
-                          deadline=time.monotonic() + dl / 1000.0)
+                          deadline=time.monotonic() + dl / 1000.0,
+                          rbucket=rbucket)
             req.trace = tr
             if tr is not None:
                 tr.span("admit", t0, time.monotonic(),
@@ -678,6 +712,8 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
             # instead of letting ServeConfig raise on it
             max_sessions=getattr(args, "max_sessions", 64),
             session_ttl_s=getattr(args, "session_ttl_s", 300.0),
+            ragged=getattr(args, "ragged", False),
+            ragged_batch_pixels=getattr(args, "ragged_batch_pixels", 0),
             engine_cache_dir=getattr(args, "engine_cache_dir", None),
             history_interval_s=getattr(args, "history_interval_s", 1.0),
             history_window=getattr(args, "history_window", 600),
@@ -718,6 +754,12 @@ def serve_cli(args, config: RAFTConfig, load_params) -> int:
           f"queue_depth={sconfig.queue_depth}  "
           f"iters_policy={server.engine.iters_policy}  "
           f"({time.monotonic() - t0:.1f}s to ready)")
+    if sconfig.ragged:
+        mh, mw = sconfig.max_box
+        print(f"[serve] ragged: ONE executable per (kind, batch-step) at "
+              f"the {mh}x{mw} arena serves every declared bucket  "
+              f"batch_pixels="
+              f"{sconfig.ragged_batch_pixels or 'unbounded'}")
     if server.streams is not None:
         print(f"[serve] streaming: max_sessions={sconfig.max_sessions}  "
               f"session_ttl={sconfig.session_ttl_s:.0f}s  "

@@ -140,23 +140,46 @@ class SlotPool:
     store → pool edge) and buffer refs swap on the single batcher
     thread; the lock makes ref reads/swaps atomic for scrape-time
     gauges.
+
+    **Ragged arena mode** (``arena=(max_h, max_w)``): every bucket key
+    collapses onto the single max-box arena — sessions of EVERY declared
+    resolution share ONE free-list and ONE set of ``[capacity+1, max_h,
+    max_w, C]`` buffers, each slot a corner-anchored zero-embedded page
+    (ops/corr.mask_ragged_rows is the layout contract).  Callers keep
+    passing their *routed* bucket; the pool maps it, so the store/stream
+    plumbing is bucket-agnostic.  A slot → extent map records each
+    live page's real ``(h, w)`` so scrape-time gauges and the budget
+    analyzer can price arena occupancy in live pixels, not box pixels.
     """
 
     _free = guarded_by("_lock")
     _bufs = guarded_by("_lock")
+    _extents = guarded_by("_lock")
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int,
+                 arena: Optional[Tuple[int, int]] = None):
         if capacity < 1:
             raise ValueError(f"slot pool capacity must be >= 1, "
                              f"got {capacity}")
         self.capacity = capacity
         self.scratch = capacity          # the padding row, never allocated
+        self.arena = None if arena is None else (int(arena[0]),
+                                                 int(arena[1]))
         self._lock = watched_lock("SlotPool._lock")
         self._free: Dict[Tuple[int, int], list] = {}
         self._bufs: Dict[Tuple[int, int], Optional[tuple]] = {}
+        # (mapped bucket, slot) -> live (h, w) of the page in that slot.
+        self._extents: Dict[Tuple[Tuple[int, int], int],
+                            Tuple[int, int]] = {}
+
+    def _b(self, bucket: Tuple[int, int]) -> Tuple[int, int]:
+        """Map a routed bucket to its storage key: identity in dense
+        mode, the shared max-box arena in ragged mode."""
+        return bucket if self.arena is None else self.arena
 
     @guarded_by("_lock")
     def _bucket_locked(self, bucket: Tuple[int, int]) -> list:
+        bucket = self._b(bucket)
         free = self._free.get(bucket)
         if free is None:
             free = self._free.setdefault(bucket,
@@ -175,18 +198,43 @@ class SlotPool:
     def free(self, bucket: Tuple[int, int], slot: int) -> None:
         with self._lock:
             self._bucket_locked(bucket).append(slot)
+            self._extents.pop((self._b(bucket), slot), None)
 
     def in_use(self, bucket: Tuple[int, int]) -> int:
         """Slots allocated in this bucket (the raft_stream_slots_in_use
-        gauge; scrape-time callback)."""
+        gauge; scrape-time callback).  In arena mode every bucket maps to
+        the shared arena, so any declared bucket reports the arena-wide
+        count."""
         with self._lock:
-            free = self._free.get(bucket)
+            free = self._free.get(self._b(bucket))
             return 0 if free is None else self.capacity - len(free)
+
+    def set_extent(self, bucket: Tuple[int, int], slot: int,
+                   extent: Tuple[int, int]) -> None:
+        """Record the live (h, w) of the page now resident in ``slot``
+        (stream coordinator, at attach/commit).  Cleared by :meth:`free`."""
+        with self._lock:
+            self._extents[(self._b(bucket), slot)] = (int(extent[0]),
+                                                      int(extent[1]))
+
+    def extent(self, bucket: Tuple[int, int],
+               slot: int) -> Optional[Tuple[int, int]]:
+        with self._lock:
+            return self._extents.get((self._b(bucket), slot))
+
+    def used_pixels(self, bucket: Tuple[int, int]) -> int:
+        """Sum of live page pixels resident in this (mapped) bucket's
+        buffers — the ragged-occupancy numerator for gauges and the
+        budget analyzer; box pixels x in_use is the denominator."""
+        b = self._b(bucket)
+        with self._lock:
+            return sum(h * w for (bk, _), (h, w) in self._extents.items()
+                       if bk == b)
 
     def buffers(self, bucket: Tuple[int, int]):
         """(fmap_buf, cnet_buf, flow_buf) or None before install."""
         with self._lock:
-            return self._bufs.get(bucket)
+            return self._bufs.get(self._b(bucket))
 
     def install(self, bucket: Tuple[int, int], bufs: tuple) -> None:
         """Install/swap this bucket's device buffers (batcher thread, or
@@ -194,7 +242,7 @@ class SlotPool:
         refs were donated and must never be used again."""
         with self._lock:
             self._bucket_locked(bucket)
-            self._bufs[bucket] = tuple(bufs)
+            self._bufs[self._b(bucket)] = tuple(bufs)
 
     def seed_row(self, bucket: Tuple[int, int],
                  slot: int) -> Optional[np.ndarray]:

@@ -59,7 +59,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..data.pipeline import pad_to_shape
+from ..data.pipeline import embed_to_shape, pad_to_shape
 from ..ops.warmstart import warm_start_seed
 from ..telemetry import events as tlm_events
 from ..telemetry import spans as tlm_spans
@@ -91,10 +91,17 @@ class StreamRequest(Request):
     __slots__ = ("session", "stream_op", "warm", "frame", "abandoned")
 
     def __init__(self, session: Session, op: str, image_padded, pads,
-                 deadline: float):
-        key = (("stream",) + tuple(session.bucket) if op == "advance"
+                 deadline: float,
+                 qbucket: Optional[Tuple[int, int]] = None):
+        # qbucket: the (H, W) the advance key coalesces on — the
+        # session's routed bucket in dense mode; under --ragged the
+        # coordinator passes the shared max box, so advances from
+        # DIFFERENT resolutions land in one FIFO and one batched step.
+        kb = tuple(session.bucket if qbucket is None else qbucket)
+        key = (("stream",) + kb if op == "advance"
                else ("stream-open", session.id))
-        super().__init__(image_padded, None, key, pads, deadline)
+        super().__init__(image_padded, None, key, pads, deadline,
+                         rbucket=tuple(session.bucket))
         self.session = session
         self.stream_op = op              # "open" | "advance"
         self.warm = False                # set at execute time
@@ -130,6 +137,40 @@ class StreamCoordinator:
         self.nonfinite = nonfinite       # raft_nonfinite_outputs_total
         self.breaker = breaker           # CircuitBreaker or None
         self.tracer = tracer             # telemetry.spans.Tracer or None
+        # ragged mixed-resolution mode (SERVING.md "Ragged serving"):
+        # every device call runs at the shared max-box arena bucket with
+        # per-row live sizes; sessions keep their ROUTED bucket for
+        # protocol/routing purposes
+        self.ragged = bool(getattr(sconfig, "ragged", False))
+        self.dev_box = sconfig.max_box if self.ragged else None
+
+    def _dev(self, s: Session) -> Tuple[int, int]:
+        """The bucket device calls run at: the session's routed bucket,
+        or the shared max-box arena under --ragged."""
+        return s.bucket if self.dev_box is None else self.dev_box
+
+    def _mask_seed(self, seed: np.ndarray,
+                   bucket: Tuple[int, int]) -> np.ndarray:
+        """Zero a warm-start seed outside the session's live 1/8-scale
+        extent: warm_start_seed forward-splats flow along itself, so
+        un-masked dead-embedding flow could leak into the live region of
+        the NEXT step's init (deterministically, but noise all the
+        same)."""
+        if self.dev_box is None:
+            return seed
+        bh, bw = bucket
+        seed = np.asarray(seed).copy()
+        seed[..., bh // 8:, :, :] = 0.0
+        seed[..., :, bw // 8:, :] = 0.0
+        return seed
+
+    def _demote_shared(self, reason: str = "degraded") -> None:
+        """Ragged twin of ``store.demote_bucket``: a failed commit killed
+        the ARENA buffers every resolution shares, so every declared
+        bucket's sessions must demote (in-flight included — same
+        single-batcher-thread safety argument)."""
+        for b in self.sconfig.buckets:
+            self.store.demote_bucket(tuple(b), reason)
 
     # -- handler-thread API ------------------------------------------------
 
@@ -236,8 +277,18 @@ class StreamCoordinator:
                 raise BadRequest(f"deadline_ms must be positive, got {dl}")
             imp, pads = pad_to_shape(image[None].astype(np.float32),
                                      s.bucket)
+            if self.dev_box is not None:
+                # ragged: zero-embed the routed-bucket frame corner-
+                # anchored into the max-box arena and fold the embedding
+                # into pads, so unpad() recovers the original resolution
+                # straight from the max-box flow
+                (bh, bw), (mh, mw) = s.bucket, self.dev_box
+                imp = embed_to_shape(imp, self.dev_box)
+                t, b_, l_, r_ = pads
+                pads = (t, b_ + mh - bh, l_, r_ + mw - bw)
             req = StreamRequest(s, op, imp, pads,
-                                deadline=time.monotonic() + dl / 1000.0)
+                                deadline=time.monotonic() + dl / 1000.0,
+                                qbucket=self.dev_box)
             req.trace = tr
             if tr is not None:
                 tr.span("admit", t0, time.monotonic(), op=op,
@@ -283,7 +334,7 @@ class StreamCoordinator:
         that owns the device."""
         s = req.session
         if req.stream_op == "open":
-            fmap, cnet = engine.run_encode(s.bucket, req.image1)
+            fmap, cnet = engine.run_encode(self._dev(s), req.image1)
             self._attach(s, engine, fmap, cnet, flow_lr=None)
             s.last_image = req.image1
             return None, None
@@ -343,7 +394,7 @@ class StreamCoordinator:
         committed), or None for rows that must heal cold (their slots
         are dropped; nothing poisoned is ever cached)."""
         s0 = reqs[0].session
-        bucket = s0.bucket
+        bucket = self._dev(s0)
         n = len(reqs)
         padded = self.sconfig.pad_batch_to(min(n, self.sconfig.max_batch))
         images = np.concatenate([r.image1 for r in reqs]
@@ -351,9 +402,17 @@ class StreamCoordinator:
         slots = np.asarray([r.session.slot for r in reqs]
                            + [self.pool.scratch] * (padded - n), np.int32)
         active = np.asarray([True] * n + [False] * (padded - n), bool)
+        sizes = None
+        if self.dev_box is not None:
+            # per-row live extents: each session's ROUTED bucket (filler
+            # rows repeat the last, matching their repeated pixels)
+            sizes = np.asarray([r.session.bucket for r in reqs]
+                               + [reqs[-1].session.bucket] * (padded - n),
+                               np.int32)
         try:
             flow, flow_lr, fmap_rows, cnet_rows, iters_used = \
-                engine.run_stream_batch(bucket, images, slots, active)
+                engine.run_stream_batch(bucket, images, slots, active,
+                                        sizes=sizes)
         except Exception:
             # the batched call itself faulted: every row degrades to the
             # cold-restart path (the solo semantics, batched — no retry:
@@ -379,8 +438,9 @@ class StreamCoordinator:
         # values back (mask), so a poisoned output can never be cached
         seeds = np.zeros((padded, h // 8, w // 8, 2), np.float32)
         for i in np.flatnonzero(row_ok):
-            seeds[i] = warm_start_seed(flow_lr[i:i + 1],
-                                       (h // 8, w // 8))[0]
+            seeds[i] = self._mask_seed(
+                warm_start_seed(flow_lr[i:i + 1], (h // 8, w // 8))[0],
+                reqs[i].session.bucket)
         mask = active.copy()
         mask[:n] &= row_ok
         try:
@@ -392,8 +452,13 @@ class StreamCoordinator:
             # EVERY session of the bucket, in-flight/queued ones
             # included (demote_bucket overrides the skip-the-locked
             # convention precisely because a kept slot would gather the
-            # zeros and serve finite garbage), then heal this group cold
-            self.store.demote_bucket(bucket)
+            # zeros and serve finite garbage), then heal this group cold.
+            # Under --ragged every resolution shares the arena buffers,
+            # so EVERY declared bucket demotes.
+            if self.dev_box is not None:
+                self._demote_shared()
+            else:
+                self.store.demote_bucket(bucket)
             for r in reqs:
                 self._degrade(r)
             return [None] * n
@@ -434,12 +499,15 @@ class StreamCoordinator:
         pairwise cost, correct flow.  Session state (slot, last_image) is
         mutated only AFTER the output passes the non-finite sentinel, so
         a faulted attempt leaves the session exactly where it was."""
-        H, W = s.bucket
-        fmap_p, cnet_p = engine.run_encode(s.bucket, s.last_image)
+        ab = self._dev(s)
+        H, W = ab
+        fmap_p, cnet_p = engine.run_encode(ab, s.last_image)
         init = np.zeros((1, H // 8, W // 8, 2), np.float32)
         self.metrics["fnet_misses"].inc()
+        sizes = (np.asarray([s.bucket], np.int32)
+                 if self.dev_box is not None else None)
         flow, flow_lr, fmap_c, cnet_c, iters_used = engine.run_stream(
-            s.bucket, req.image1, fmap_p, cnet_p, init)
+            ab, req.image1, fmap_p, cnet_p, init, sizes=sizes)
         if not (np.isfinite(flow).all() and np.isfinite(flow_lr).all()):
             # non-finite OUTPUT sentinel (inputs were validated at the
             # HTTP edge): never cache poisoned maps or a poisoned seed
@@ -470,9 +538,15 @@ class StreamCoordinator:
         slot = self.store.promote(s)
         if slot is None:
             return
-        H, W = s.bucket
-        seed = warm_start_seed(flow_lr, (H // 8, W // 8))
+        ab = self._dev(s)
+        H, W = ab
+        seed = self._mask_seed(warm_start_seed(flow_lr, (H // 8, W // 8)),
+                               s.bucket)
         try:
-            engine.commit_row(s.bucket, slot, fmap, cnet, seed)
+            engine.commit_row(ab, slot, fmap, cnet, seed)
+            self.pool.set_extent(s.bucket, slot, s.bucket)
         except Exception:
-            self.store.demote_bucket(s.bucket)
+            if self.dev_box is not None:
+                self._demote_shared()
+            else:
+                self.store.demote_bucket(s.bucket)

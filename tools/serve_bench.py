@@ -1729,6 +1729,242 @@ def run_coldstart_bench(args) -> int:
     return 0
 
 
+def _mixed_load(host, port, bodies, clients, total, mode, rate, seed=0):
+    """The pairwise mixed-resolution phase: ``total`` requests cycling
+    round-robin over one npz body per declared resolution.  Open-loop
+    (Poisson arrivals at ``rate``) or closed-loop, same worker pool shape
+    as run_open/run_closed — only the per-request body varies."""
+    import queue as _q
+    results, lock = [], threading.Lock()
+    jobs = _q.Queue()
+
+    def worker():
+        c = Client(host, port, b"", results, lock)
+        while True:
+            item = jobs.get()
+            if item is None:
+                return
+            c.body = item
+            c.one()
+
+    threads = [threading.Thread(target=worker) for _ in range(clients)]
+    for t in threads:
+        t.start()
+    rng = np.random.RandomState(seed)
+    t0 = time.monotonic()
+    next_t = t0
+    for i in range(total):
+        if mode == "open":
+            next_t += rng.exponential(1.0 / rate)
+            delay = next_t - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+        jobs.put(bodies[i % len(bodies)])
+    for _ in threads:
+        jobs.put(None)
+    for t in threads:
+        t.join()
+    return results, time.monotonic() - t0
+
+
+def run_ragged_bench(args) -> int:
+    """--ragged-sweep: the mixed-resolution serving comparison.
+
+    The SAME load — pairwise requests cycling over every declared
+    resolution, then one live stream per resolution advancing in
+    lockstep — is driven through two fresh in-process servers: DENSE
+    (per-bucket executables and FIFOs, the same-bucket baseline) and
+    RAGGED (--ragged: one max-box arena, one executable family,
+    cross-resolution coalescing).  Per arm the record reports executable
+    count, batch occupancy, padding-waste ratio, stream step width, and
+    compile misses; the comparison block prices the collapse.
+
+    --smoke gates the acceptance criteria: the executable count shrinks
+    by the declared bucket count, mixed-resolution occupancy is no worse
+    than the same-bucket baseline, the ragged stream steps really
+    coalesce across resolutions (mean width > 1 where the dense arm is
+    structurally pinned to 1), zero compiles after warmup in BOTH arms,
+    and zero lock-order violations with the watch armed."""
+    from raft_tpu.config import RAFTConfig, init_rng
+    from raft_tpu.models import init_raft
+    from raft_tpu.serving import FlowServer, ServeConfig, parse_buckets
+
+    # every sweep doubles as a race hunt over the shared-arena locking
+    # (armed BEFORE the servers construct their locks)
+    os.environ.setdefault("RAFT_TPU_LOCK_WATCH", "1")
+    bucket_spec = args.buckets or ("16x24,24x32,32x48" if args.small
+                                   else "48x64,72x96,96x128")
+    buckets = tuple(parse_buckets(bucket_spec))
+    if len(buckets) < 3:
+        print("ERROR: --ragged-sweep needs >= 3 declared buckets to "
+              "measure the mixed-resolution collapse")
+        return 2
+    config = (RAFTConfig.small_model(iters=args.iters or 2)
+              if args.small else RAFTConfig.full(iters=args.iters or 12))
+    params = init_raft(init_rng(), config)
+
+    # one pairwise body per resolution, each 2px under its bucket so the
+    # routed pads AND (ragged arm) the max-box embedding are exercised
+    rng = np.random.RandomState(0)
+    bodies, body_hw = [], []
+    for bh, bw in buckets:
+        h, w = bh - 2, bw - 2
+        im1 = rng.rand(h, w, 3).astype(np.float32)
+        im2 = np.clip(im1 + rng.randn(h, w, 3).astype(np.float32) * 0.05,
+                      0, 1)
+        bodies.append(_npz(image1=im1, image2=im2))
+        body_hw.append([h, w])
+    # one stream per resolution: the dense arm can then NEVER coalesce a
+    # stream step (one session per bucket FIFO) while the ragged arm must
+    # — the cleanest cross-resolution width contrast
+    sessions = args.sessions or len(buckets)
+    seqs = [make_session_frames(buckets[i % len(buckets)][0] - 2,
+                                buckets[i % len(buckets)][1] - 2,
+                                args.frames, seed=100 + i,
+                                shift=args.shift)
+            for i in range(sessions)]
+    pair_total = args.requests
+    print(f"[bench] ragged sweep: {len(buckets)} resolutions "
+          f"({bucket_spec}), {pair_total} mixed pairwise requests "
+          f"({args.mode} loop), {sessions} stream(s) x {args.frames} "
+          f"frames")
+
+    def one_arm(ragged):
+        sconfig = ServeConfig(
+            buckets=buckets, max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms, queue_depth=args.queue_depth,
+            default_deadline_ms=args.deadline_ms, port=0,
+            max_sessions=sessions, trace_sample=0.0,
+            history_interval_s=0.0, ragged=ragged)
+        server = FlowServer(config, params, sconfig, verbose=False)
+        t0 = time.monotonic()
+        server.start()
+        warm_s = time.monotonic() - t0
+        host, port = sconfig.host, server.port
+        executables = server.engine.executables
+        print(f"[bench] {'ragged' if ragged else 'dense'} arm: "
+              f"{executables} executables warmed in {warm_s:.1f}s")
+        prom0 = scrape(host, port)
+        pair_res, pair_s = _mixed_load(host, port, bodies, args.clients,
+                                       pair_total, args.mode, args.rate)
+        prom1 = scrape(host, port)
+        stream_res, stream_s = run_video(host, port, seqs, stream=True)
+        prom2 = scrape(host, port)
+        server.stop()
+        pair_d, stream_d = diff_prom(prom0, prom1), diff_prom(prom1, prom2)
+
+        def phase(results, elapsed, d):
+            ok = sum(1 for st, _ in results if st == 200)
+            occ_cnt = d.get("raft_serving_batch_occupancy_count", 0)
+            bs_cnt = d.get("raft_serving_batch_size_count", 0)
+            waste_cnt = d.get("raft_batch_padding_waste_ratio_count", 0)
+            return {
+                "pairs_per_sec": round(ok / elapsed, 3) if elapsed
+                else 0.0,
+                "ok": ok, "elapsed_s": round(elapsed, 3),
+                "device_calls": int(bs_cnt),
+                # real requests per device call — the utilization number
+                # the dense arm can't game by running batch-1 calls at
+                # occupancy 1.0
+                "batch_size_mean": round(
+                    d.get("raft_serving_batch_size_sum", 0.0)
+                    / bs_cnt, 3) if bs_cnt else None,
+                "batch_occupancy_mean": round(
+                    d.get("raft_serving_batch_occupancy_sum", 0.0)
+                    / occ_cnt, 3) if occ_cnt else None,
+                "padding_waste_mean": round(
+                    d.get("raft_batch_padding_waste_ratio_sum", 0.0)
+                    / waste_cnt, 3) if waste_cnt else None,
+            }
+
+        step_cnt = stream_d.get("raft_stream_step_batch_count", 0)
+        arm = {
+            "executables": executables,
+            "warmup_s": round(warm_s, 1),
+            "pairwise": phase(pair_res, pair_s, pair_d),
+            "stream": dict(
+                phase([(st, t) for st, t in stream_res], stream_s,
+                      stream_d),
+                step_batch_mean=round(
+                    stream_d.get("raft_stream_step_batch_sum", 0.0)
+                    / step_cnt, 3) if step_cnt else None),
+            "compile_misses_after_warmup": int(prom2.get(
+                "raft_serving_compile_cache_misses_total", -1)),
+            "lock_order_violations": (
+                int(prom2["raft_lock_order_violations_total"])
+                if "raft_lock_order_violations_total" in prom2 else None),
+        }
+        return arm
+
+    dense = one_arm(False)
+    ragged = one_arm(True)
+    rec = {
+        "bench": "serving_ragged", "mode": args.mode,
+        "rate_rps": args.rate if args.mode == "open" else None,
+        "buckets": [list(b) for b in buckets], "image_hw": body_hw,
+        "clients": args.clients, "requests": pair_total,
+        "sessions": sessions, "frames": args.frames,
+        "max_batch": args.max_batch, "max_wait_ms": args.max_wait_ms,
+        "dense": dense, "ragged": ragged,
+        "executable_reduction": round(
+            dense["executables"] / ragged["executables"], 2),
+    }
+    from raft_tpu.telemetry import run_manifest
+    rec["manifest"] = run_manifest(config=config, mode="serve_bench")
+    print(json.dumps(rec, indent=2))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"[bench] appended to {args.out}")
+
+    if args.smoke:
+        problems = []
+        if rec["executable_reduction"] < len(buckets):
+            problems.append(
+                f"executable count shrank only "
+                f"{rec['executable_reduction']}x (expected "
+                f"{len(buckets)}x at {len(buckets)} buckets)")
+        for name, arm in (("dense", dense), ("ragged", ragged)):
+            if arm["compile_misses_after_warmup"] != 0:
+                problems.append(
+                    f"{arm['compile_misses_after_warmup']} compile(s) "
+                    f"after warmup in the {name} arm")
+            if arm["lock_order_violations"] is None:
+                problems.append(f"lock-order validator families missing "
+                                f"from the {name} arm's /metrics")
+            elif arm["lock_order_violations"]:
+                problems.append(
+                    f"{arm['lock_order_violations']} lock-order "
+                    f"violation(s) in the {name} arm")
+            if not arm["pairwise"]["ok"] or not arm["stream"]["ok"]:
+                problems.append(f"failed requests in the {name} arm: "
+                                f"pair ok={arm['pairwise']['ok']} "
+                                f"stream ok={arm['stream']['ok']}")
+        width = ragged["stream"]["step_batch_mean"]
+        if width is None or width <= 1.0:
+            problems.append(
+                f"ragged stream steps never coalesced across "
+                f"resolutions (mean width {width})")
+        d_bs = dense["pairwise"]["batch_size_mean"]
+        r_bs = ragged["pairwise"]["batch_size_mean"]
+        if d_bs is not None and r_bs is not None and r_bs < d_bs - 0.05:
+            problems.append(
+                f"mixed-resolution coalescing ({r_bs} requests/call) "
+                f"fell below the same-bucket baseline ({d_bs})")
+        if ragged["pairwise"]["padding_waste_mean"] is None:
+            problems.append("padding-waste histogram never filled in "
+                            "the ragged arm")
+        if problems:
+            print("[bench] SMOKE FAIL: " + "; ".join(problems))
+            return 1
+        print(f"[bench] ragged sweep: {dense['executables']} -> "
+              f"{ragged['executables']} executables "
+              f"({rec['executable_reduction']}x), stream width "
+              f"{width}, pairwise coalescing {d_bs} -> {r_bs} "
+              f"requests/call — SMOKE PASS")
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description="serving load generator")
     p.add_argument("--url", default=None,
@@ -1818,6 +2054,12 @@ def main() -> int:
                    help="fleet arm: replica count (the scaling ratio is "
                         "measured against a one-replica phase of the "
                         "same fleet, same pinning)")
+    p.add_argument("--ragged-sweep", action="store_true",
+                   help="mixed-resolution comparison: the same pairwise+"
+                        "stream load over >= 3 resolutions through a "
+                        "dense per-bucket server and a --ragged one-"
+                        "arena server (executables, occupancy, padding "
+                        "waste, stream width)")
     p.add_argument("--coldstart", action="store_true",
                    help="AOT-cache boot race: cold boot (empty cache dir, "
                         "everything compiles + serializes) vs cached boot "
@@ -1851,6 +2093,12 @@ def main() -> int:
         print("ERROR: --coldstart races two in-process boots "
               "(no --url / --video / --chaos / --fleet)")
         return 2
+    if args.ragged_sweep and (args.url or args.video or args.chaos
+                              or args.fleet or args.coldstart):
+        print("ERROR: --ragged-sweep drives its own dense-vs-ragged "
+              "in-process pair (no --url / --video / --chaos / --fleet "
+              "/ --coldstart)")
+        return 2
 
     if args.smoke:
         args.small = True
@@ -1868,7 +2116,8 @@ def main() -> int:
             # server constructs its locks)
             os.environ.setdefault("RAFT_TPU_LOCK_WATCH", "1")
         args.cpu = True
-        if args.iters_policy is None and not args.url:
+        if args.iters_policy is None and not args.url \
+                and not args.ragged_sweep:
             # the smoke exercises the adaptive path by default: counted
             # executables, policy-keyed cache, iters histogram — and the
             # watchdog proves data-dependent trip counts never recompile.
@@ -1893,6 +2142,9 @@ def main() -> int:
 
     if args.fleet:
         return run_fleet_bench(args)
+
+    if args.ragged_sweep:
+        return run_ragged_bench(args)
 
     h, w = args.size
     rng = np.random.RandomState(0)
